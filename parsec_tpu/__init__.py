@@ -1,0 +1,25 @@
+"""parsec-tpu: a TPU-native task-based runtime.
+
+A from-scratch rebuild of the capabilities of PaRSEC (the Parallel Runtime
+Scheduler and Execution Controller, reference at ``/root/reference``):
+applications are DAGs of tiled micro-tasks with labeled data-dependency edges,
+expressed through a Parameterized Task Graph DSL or a dynamic insert-task API,
+and executed by a distributed scheduler that overlaps computation with data
+movement.
+
+TPU-first design (not a port):
+
+- tiles are HBM-resident ``jax.Array`` copies staged through device hooks;
+- task bodies are XLA/Pallas kernel "incarnations" selected per device;
+- regular (affine) taskpools additionally lower to fused SPMD programs
+  (``shard_map`` over a ``jax.sharding.Mesh`` with XLA collectives) — the
+  high-performance path on pods, with the dynamic runtime as the general one;
+- inter-chip dependency activation and tile movement ride ICI/DCN via XLA
+  collectives and device-to-device copies instead of MPI.
+
+See SURVEY.md at the repo root for the reference's full structural analysis.
+"""
+
+from .version import __version__
+
+__all__ = ["__version__"]

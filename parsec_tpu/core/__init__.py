@@ -1,0 +1,28 @@
+"""Foundation layer: params, component registry, pools, futures, queues.
+
+TPU-native rebuild of the reference's layer 0–1 (``parsec/class/``,
+``parsec/utils/``, ``parsec/mca/`` core — SURVEY §2.1, §2.2, §2.4).  The
+OpenMPI-style C object system (``parsec_object.h``) maps to plain Python
+classes with gc; the atomic lists/LIFOs map to striped locks + GIL-safe
+structures here and to the native C++ tier for the dispatch hot path.
+"""
+
+from .backoff import Backoff
+from .future import CountableFuture, DataCopyFuture, Future
+from .hash_table import ConcurrentHashTable
+from .hbbuffer import HBBuffer
+from .info import Info, InfoObjectArray, per_device_infos, per_stream_infos
+from .mca import Component, ComponentRepository, component, repository
+from .mempool import Mempool, ThreadMempool
+from .output import (FatalError, debug_verbose, fatal, inform, output_open,
+                     warning)
+from .params import ParamRegistry, params, register
+
+__all__ = [
+    "Backoff", "Component", "ComponentRepository", "ConcurrentHashTable",
+    "CountableFuture", "DataCopyFuture", "FatalError", "Future", "HBBuffer",
+    "Info", "InfoObjectArray", "Mempool", "ParamRegistry", "ThreadMempool",
+    "component", "debug_verbose", "fatal", "inform", "output_open", "params",
+    "per_device_infos", "per_stream_infos", "register", "repository",
+    "warning",
+]

@@ -1,0 +1,151 @@
+"""Futures: completion promises with chained callbacks.
+
+Rebuild of ``parsec/class/parsec_future.h:39-53`` (countable future vtable) and
+``parsec_datacopy_future.c`` (futures that resolve to a data copy and support
+*nested* reshape futures).  Python's stdlib future is not enough: the reference
+contract needs (a) countable futures that trigger after N ``set`` events,
+(b) enable/trigger callbacks evaluated by the *getter* so work can run lazily
+on the consumer's thread, and (c) nesting for layout conversion chains — the
+substrate of the reshape system (SURVEY §2.3).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+# Status flags mirror parsec_future.h:55-59.
+FUTURE_STATUS_NASCENT = 0
+FUTURE_STATUS_INIT = 1 << 0
+FUTURE_STATUS_TRIGGERED = 1 << 1
+FUTURE_STATUS_COMPLETED = 1 << 2
+
+
+class Future:
+    """A single-assignment future with completion callbacks."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._status = FUTURE_STATUS_NASCENT
+        self._value: Any = None
+        self._callbacks: list[Callable[["Future"], None]] = []
+
+    @property
+    def status(self) -> int:
+        return self._status
+
+    def is_ready(self) -> bool:
+        return bool(self._status & FUTURE_STATUS_COMPLETED)
+
+    def on_ready(self, cb: Callable[["Future"], None]) -> None:
+        """Register a callback; fires immediately when already completed."""
+        fire = False
+        with self._cond:
+            if self.is_ready():
+                fire = True
+            else:
+                self._callbacks.append(cb)
+        if fire:
+            cb(self)
+
+    def set(self, value: Any) -> None:
+        with self._cond:
+            if self.is_ready():
+                raise RuntimeError("future already completed")
+            self._value = value
+            self._status |= FUTURE_STATUS_COMPLETED
+            cbs, self._callbacks = self._callbacks, []
+            self._cond.notify_all()
+        for cb in cbs:
+            cb(self)
+
+    def get(self, timeout: float | None = None) -> Any:
+        """Block until completed and return the value."""
+        with self._cond:
+            if not self._cond.wait_for(self.is_ready, timeout):
+                raise TimeoutError("future not completed")
+            return self._value
+
+
+class CountableFuture(Future):
+    """Completes after ``count`` contributions (cf. countable future vtable).
+
+    Each :meth:`contribute` supplies a partial value folded by ``combine``;
+    the final fold result becomes the future's value.
+    """
+
+    def __init__(self, count: int,
+                 combine: Callable[[Any, Any], Any] | None = None) -> None:
+        super().__init__()
+        if count <= 0:
+            raise ValueError("count must be positive")
+        self._remaining = count
+        self._combine = combine
+        self._acc: Any = None
+        self._first = True
+
+    def contribute(self, value: Any = None) -> None:
+        with self._cond:
+            if self._remaining <= 0:
+                raise RuntimeError("countable future already satisfied")
+            if self._first:
+                self._acc, self._first = value, False
+            elif self._combine is not None:
+                self._acc = self._combine(self._acc, value)
+            self._remaining -= 1
+            done = self._remaining == 0
+        if done:
+            self.set(self._acc)
+
+
+class DataCopyFuture(Future):
+    """Future resolving to a data copy, with lazy getter-side materialization.
+
+    The reference's datacopy future (``parsec_datacopy_future.c``) carries an
+    *enable* callback: the first consumer to ``get`` while the source is ready
+    runs the conversion (e.g. a reshape/relayout kernel) on its own thread.
+    Nested futures chain conversions: ``self`` may wait on ``parent`` and then
+    apply ``convert`` to the parent's resolved copy.
+    """
+
+    def __init__(
+        self,
+        parent: "Future | None" = None,
+        convert: Callable[[Any], Any] | None = None,
+    ) -> None:
+        super().__init__()
+        self._parent = parent
+        self._convert = convert
+        self._trigger_lock = threading.Lock()
+
+    def trigger(self) -> None:
+        """Run (once) the conversion chain if the parent is resolved."""
+        with self._trigger_lock:
+            if self.is_ready():
+                return
+            if self._parent is not None:
+                src = self._parent.get()
+            else:
+                src = None
+            value = self._convert(src) if self._convert is not None else src
+            with self._cond:
+                self._status |= FUTURE_STATUS_TRIGGERED
+            self.set(value)
+
+    def get(self, timeout: float | None = None) -> Any:
+        # Getter-side evaluation: materialize lazily instead of blocking,
+        # when the parent chain can be resolved from this thread.
+        if not self.is_ready() and (
+            self._parent is None or _chain_resolvable(self._parent)
+        ):
+            self.trigger()
+        return super().get(timeout)
+
+
+def _chain_resolvable(f: Future) -> bool:
+    if f.is_ready():
+        return True
+    if isinstance(f, DataCopyFuture):
+        p = f._parent
+        return p is None or _chain_resolvable(p)
+    return False

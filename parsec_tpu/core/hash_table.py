@@ -1,0 +1,70 @@
+"""Striped-lock concurrent hash table.
+
+Rebuild of ``parsec/class/parsec_hash_table.{c,h}`` (resizable bucketed hash
+table with per-bucket locks; used for dependency tracking, DTD tiles, and the
+taskpool registry).  CPython dicts are already thread-safe for single ops, but
+the runtime needs the reference's *compound* atomic operations:
+``find_or_insert`` (dep lookup), ``remove`` returning the element, and
+``lock_bucket``-style critical sections keyed by hash — hence lock striping.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Hashable, Iterator
+
+_NSTRIPES = 64
+
+
+class ConcurrentHashTable:
+    def __init__(self, nstripes: int = _NSTRIPES) -> None:
+        self._stripes = [threading.RLock() for _ in range(nstripes)]
+        self._maps: list[dict[Hashable, Any]] = [dict() for _ in range(nstripes)]
+
+    def _stripe(self, key: Hashable) -> int:
+        return hash(key) % len(self._stripes)
+
+    def get(self, key: Hashable, default: Any = None) -> Any:
+        i = self._stripe(key)
+        with self._stripes[i]:
+            return self._maps[i].get(key, default)
+
+    def insert(self, key: Hashable, value: Any) -> None:
+        i = self._stripe(key)
+        with self._stripes[i]:
+            self._maps[i][key] = value
+
+    def find_or_insert(self, key: Hashable, factory: Callable[[], Any]) -> Any:
+        """Atomic get-or-create — the dep-hash hot path
+        (cf. ``parsec_hash_find_deps``, parsec.c:1501)."""
+        i = self._stripe(key)
+        with self._stripes[i]:
+            m = self._maps[i]
+            v = m.get(key)
+            if v is None:
+                v = factory()
+                m[key] = v
+            return v
+
+    def remove(self, key: Hashable) -> Any | None:
+        i = self._stripe(key)
+        with self._stripes[i]:
+            return self._maps[i].pop(key, None)
+
+    def locked(self, key: Hashable):
+        """Context manager holding the bucket lock for ``key`` (compound
+        read-modify-write sections, cf. ``parsec_hash_table_lock_bucket``)."""
+        return self._stripes[self._stripe(key)]
+
+    def __len__(self) -> int:
+        return sum(len(m) for m in self._maps)
+
+    def __contains__(self, key: Hashable) -> bool:
+        i = self._stripe(key)
+        with self._stripes[i]:
+            return key in self._maps[i]
+
+    def items(self) -> Iterator[tuple[Hashable, Any]]:
+        for i, m in enumerate(self._maps):
+            with self._stripes[i]:
+                yield from list(m.items())

@@ -1,0 +1,60 @@
+"""Hierarchical bounded buffer — building block of local-queue schedulers.
+
+Rebuild of ``parsec/class/hbbuffer.{h,c}``: a fixed-capacity task buffer that
+*spills to a parent store* when full.  Local-queue schedulers (LFQ/LTQ/LHQ in
+the reference) stack these: per-thread buffer → per-VP/system overflow queue.
+Pushes that do not fit locally overflow to the parent via ``parent_push``;
+pops scan newest-first (LIFO-ish locality) with an optional best-priority
+selection.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+
+class HBBuffer:
+    def __init__(self, capacity: int,
+                 parent_push: Callable[[list[Any], int], None]) -> None:
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._parent_push = parent_push
+        self._items: list[Any] = []
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def push_all(self, items: list[Any], distance: int = 0) -> None:
+        """Push as many as fit; spill the rest (lowest priority first kept
+        local? no — reference keeps the *head* local and spills the tail)."""
+        overflow: list[Any] = []
+        with self._lock:
+            room = self.capacity - len(self._items)
+            if room >= len(items):
+                self._items.extend(items)
+            else:
+                if room > 0:
+                    self._items.extend(items[:room])
+                overflow = items[room:]
+        if overflow:
+            self._parent_push(overflow, distance + 1)
+
+    def try_pop_best(self, priority: Callable[[Any], float] | None = None) -> Any | None:
+        with self._lock:
+            if not self._items:
+                return None
+            if priority is None:
+                return self._items.pop()
+            best_i = max(range(len(self._items)),
+                         key=lambda i: priority(self._items[i]))
+            return self._items.pop(best_i)
+
+    def steal(self) -> Any | None:
+        """Victim-side pop from the *oldest* end (work-stealing fairness)."""
+        with self._lock:
+            if not self._items:
+                return None
+            return self._items.pop(0)

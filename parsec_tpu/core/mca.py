@@ -1,0 +1,98 @@
+"""Modular component architecture (MCA) — pluggable policy registry.
+
+Rebuild of ``parsec/mca/mca.h`` + ``mca_repository.c`` (static component
+registry; open-by-type, priority-based query, close).  Components are grouped
+by *type* (``sched``, ``termdet``, ``pins``, ``device``, ``comm``); selection
+happens either by explicit name through the ``<type>`` MCA param (the
+reference's ``--mca sched spq``) or by highest priority among components whose
+``query`` accepts the current context.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+from .params import params
+
+
+class Component:
+    """Base class for MCA components (one per policy implementation).
+
+    Subclasses set ``type_name`` (component family) and ``name``; ``priority``
+    orders automatic selection (higher wins — lfq registers 20 in the
+    reference, ``sched/lfq/sched_lfq_component.c:73``).
+    """
+
+    type_name: str = ""
+    name: str = ""
+    priority: int = 0
+
+    def query(self, context: Any = None) -> bool:
+        """Return True when this component can serve ``context``."""
+        return True
+
+    def open(self, context: Any = None) -> Any:
+        """Instantiate the component's module for ``context``."""
+        raise NotImplementedError
+
+    def close(self, module: Any) -> None:
+        pass
+
+
+class ComponentRepository:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._by_type: dict[str, dict[str, Component]] = {}
+
+    def register(self, component: Component) -> Component:
+        with self._lock:
+            fam = self._by_type.setdefault(component.type_name, {})
+            fam[component.name] = component
+        return component
+
+    def components_of_type(self, type_name: str) -> list[Component]:
+        with self._lock:
+            return sorted(
+                self._by_type.get(type_name, {}).values(),
+                key=lambda c: -c.priority,
+            )
+
+    def find(self, type_name: str, name: str) -> Component | None:
+        with self._lock:
+            return self._by_type.get(type_name, {}).get(name)
+
+    def query(self, type_name: str, context: Any = None,
+              requested: str | None = None) -> Component:
+        """Select a component: explicit request, else best accepted priority.
+
+        ``requested`` falls back to the ``<type_name>`` MCA param when
+        registered (mirrors ``mca_components_open_bytype`` +
+        ``mca_components_query``).
+        """
+        if requested is None:
+            requested = params.get(type_name, default="")
+        if requested:
+            c = self.find(type_name, requested)
+            if c is None:
+                raise LookupError(
+                    f"no MCA component '{requested}' of type '{type_name}'"
+                )
+            return c
+        for c in self.components_of_type(type_name):
+            if c.query(context):
+                return c
+        raise LookupError(f"no usable MCA component of type '{type_name}'")
+
+
+repository = ComponentRepository()
+
+
+def component(cls: type | None = None) -> Callable[[type], type] | type:
+    """Class decorator registering a Component subclass at import time."""
+
+    def wrap(klass: type) -> type:
+        repository.register(klass())
+        return klass
+
+    return wrap(cls) if cls is not None else wrap

@@ -1,0 +1,87 @@
+"""Object / buffer pools.
+
+Rebuild of ``parsec/class/mempool.{h,c}`` (per-thread freelist pools whose
+elements carry an owner pointer so they can be returned from any thread) and
+``utils/zone_malloc.c`` (segment allocator carving a device memory reservation
+into tiles — the HBM allocator analog, see device layer).
+
+In the Python tier these pools exist to avoid allocation on the dispatch hot
+path (task shells, repo entries); the native tier (native/) provides the
+C++ equivalent for the p50-dispatch-critical path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+
+class ThreadMempool:
+    """One thread's freelist (cf. ``parsec_thread_mempool_t``)."""
+
+    __slots__ = ("parent", "_free")
+
+    def __init__(self, parent: "Mempool") -> None:
+        self.parent = parent
+        self._free: list[Any] = []
+
+    def allocate(self) -> Any:
+        if self._free:
+            obj = self._free.pop()
+        else:
+            obj = self.parent.factory()
+        # stamp the owning thread pool so any thread can return it
+        try:
+            obj._mempool_owner = self
+        except AttributeError:
+            pass
+        return obj
+
+    def free(self, obj: Any) -> None:
+        if self.parent.reset is not None:
+            self.parent.reset(obj)
+        self._free.append(obj)
+
+
+class Mempool:
+    """A pool of identical objects with per-thread freelists.
+
+    ``thread_pool()`` hands each execution stream its own lock-free freelist;
+    ``free(obj)`` returns the element to its *owner's* list (single-producer)
+    exactly like ``parsec_mempool_free`` routing through the element's owner
+    pointer.
+    """
+
+    def __init__(self, factory: Callable[[], Any],
+                 reset: Callable[[Any], None] | None = None) -> None:
+        self.factory = factory
+        self.reset = reset
+        self._tls = threading.local()
+        self._all: list[ThreadMempool] = []
+        self._lock = threading.Lock()
+
+    def thread_pool(self) -> ThreadMempool:
+        tp = getattr(self._tls, "pool", None)
+        if tp is None:
+            tp = ThreadMempool(self)
+            self._tls.pool = tp
+            with self._lock:
+                self._all.append(tp)
+        return tp
+
+    def allocate(self) -> Any:
+        return self.thread_pool().allocate()
+
+    def free(self, obj: Any) -> None:
+        owner = getattr(obj, "_mempool_owner", None)
+        if owner is not None and owner.parent is self:
+            owner.free(obj)
+        else:
+            self.thread_pool().free(obj)
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "thread_pools": len(self._all),
+                "free_elements": sum(len(tp._free) for tp in self._all),
+            }

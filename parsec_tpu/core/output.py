@@ -1,0 +1,103 @@
+"""Leveled diagnostic output streams.
+
+TPU-native analog of the reference's ``parsec/utils/output.c`` /
+``utils/debug.c`` (verbosity-leveled output streams, ``parsec_fatal`` /
+``parsec_warning`` / ``parsec_inform``, ``PARSEC_DEBUG_VERBOSE``).  Idiomatic
+rebuild on top of :mod:`logging` rather than a hand-rolled stream table: each
+subsystem opens a named stream with its own verbosity, sourced from the param
+system (``debug_verbose`` et al.).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+
+_lock = threading.Lock()
+_streams: dict[str, "OutputStream"] = {}
+
+_root = logging.getLogger("parsec_tpu")
+if not _root.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter("[parsec-tpu %(name)s] %(message)s"))
+    _root.addHandler(_h)
+    _root.setLevel(logging.WARNING)
+    _root.propagate = False
+
+
+class FatalError(RuntimeError):
+    """Raised by :func:`fatal` — the rebuild's analog of ``parsec_fatal``.
+
+    The reference aborts the process (``parsec_weaksym_exit``,
+    ``parsec.c:160-166``); a library embedded in a JAX program raises instead.
+    """
+
+
+class OutputStream:
+    """A named, verbosity-leveled output stream (cf. ``parsec_output_open``)."""
+
+    def __init__(self, name: str, verbose: int = 0):
+        self.name = name
+        self.verbose = verbose
+        self._log = _root.getChild(name)
+        self._log.setLevel(logging.DEBUG)
+
+    def verbose_out(self, level: int, msg: str, *args) -> None:
+        """Emit ``msg`` when this stream's verbosity is >= ``level``.
+
+        Mirrors ``PARSEC_DEBUG_VERBOSE(level, stream, fmt, ...)``.
+        """
+        if self.verbose >= level:
+            self._log.warning(msg, *args)
+
+    def inform(self, msg: str, *args) -> None:
+        self._log.warning(msg, *args)
+
+    def warning(self, msg: str, *args) -> None:
+        self._log.warning("WARNING: " + msg, *args)
+
+
+def output_open(name: str, verbose: int | None = None) -> OutputStream:
+    """Open (or fetch) the named stream; ``verbose`` defaults from the MCA
+    param system (``debug_verbose`` globally, ``debug_verbose_<name>`` per
+    stream — sourced cli > env > file > default like every param)."""
+    from .params import params
+
+    with _lock:
+        st = _streams.get(name)
+        if st is None:
+            if verbose is None:
+                default = params.register(
+                    "debug_verbose", 0, "global debug verbosity level").value
+                verbose = params.register(
+                    f"debug_verbose_{name}", default,
+                    f"debug verbosity for the '{name}' stream").value
+            st = OutputStream(name, verbose)
+            _streams[name] = st
+        elif verbose is not None:
+            st.verbose = verbose
+        return st
+
+
+# Default debug stream, mirroring utils/debug.c's parsec_debug_output.
+debug_stream = output_open("debug")
+
+
+def debug_verbose(level: int, stream: OutputStream | str, msg: str, *args) -> None:
+    if isinstance(stream, str):
+        stream = output_open(stream)
+    stream.verbose_out(level, msg, *args)
+
+
+def inform(msg: str, *args) -> None:
+    debug_stream.inform(msg, *args)
+
+
+def warning(msg: str, *args) -> None:
+    debug_stream.warning(msg, *args)
+
+
+def fatal(msg: str, *args) -> None:
+    debug_stream._log.error("FATAL: " + msg, *args)
+    raise FatalError(msg % args if args else msg)

@@ -1,0 +1,173 @@
+"""Typed runtime parameters ("MCA params").
+
+Rebuild of the reference's Open-MPI-heritage MCA parameter system
+(``parsec/utils/mca_param.c:1-2606``): parameters are registered at point of
+use with a type, default, and help text, and resolved from (priority order)
+
+1. explicit CLI-style overrides (``--mca name value`` / ``--parsec-mca``),
+2. environment ``PARSEC_MCA_<name>``,
+3. a param file (``~/.parsec/mca-params.conf`` analog, cf.
+   ``mca_parse_paramfile.c``),
+4. the registered default.
+
+Components themselves are selected through params (``--mca sched lfq``),
+exactly as in the reference (SURVEY §5.6).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+_TYPES: dict[str, Callable[[str], Any]] = {
+    "int": int,
+    "float": float,
+    "bool": lambda s: s.strip().lower() in ("1", "true", "yes", "on"),
+    "string": str,
+}
+
+
+@dataclass
+class Param:
+    name: str
+    type: str
+    default: Any
+    help: str = ""
+    read_only: bool = False
+    # where the current value came from: default/env/file/cli/set
+    source: str = "default"
+    value: Any = None
+
+
+class ParamRegistry:
+    """Process-global registry of typed parameters."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._params: dict[str, Param] = {}
+        self._cli_overrides: dict[str, str] = {}
+        self._file_values: dict[str, str] = {}
+
+    # -- registration (cf. parsec_mca_param_reg_int_name etc.) --------------
+    def register(
+        self,
+        name: str,
+        default: Any,
+        help: str = "",
+        type: str | None = None,
+        read_only: bool = False,
+    ) -> Param:
+        if type is None:
+            type = (
+                "bool"
+                if isinstance(default, bool)
+                else "int"
+                if isinstance(default, int)
+                else "float"
+                if isinstance(default, float)
+                else "string"
+            )
+        with self._lock:
+            p = self._params.get(name)
+            if p is None:
+                p = Param(name=name, type=type, default=default, help=help,
+                          read_only=read_only)
+                p.value, p.source = self._resolve(p)
+                self._params[name] = p
+            return p
+
+    def _resolve(self, p: Param) -> tuple[Any, str]:
+        conv = _TYPES[p.type]
+        if p.name in self._cli_overrides:
+            return conv(self._cli_overrides[p.name]), "cli"
+        env = os.environ.get(f"PARSEC_MCA_{p.name}")
+        if env is not None:
+            return conv(env), "env"
+        if p.name in self._file_values:
+            return conv(self._file_values[p.name]), "file"
+        return p.default, "default"
+
+    # -- lookup / mutation ---------------------------------------------------
+    def get(self, name: str, default: Any = None) -> Any:
+        with self._lock:
+            p = self._params.get(name)
+            if p is None:
+                if default is None:
+                    raise KeyError(f"unregistered param: {name}")
+                return default
+            return p.value
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            p = self._params.get(name)
+            if p is None:
+                raise KeyError(f"unregistered param: {name}")
+            if p.read_only:
+                raise PermissionError(f"param {name} is read-only")
+            p.value, p.source = _TYPES[p.type](str(value)), "set"
+
+    # -- external sources ----------------------------------------------------
+    def parse_cmdline(self, argv: list[str]) -> list[str]:
+        """Consume ``--mca <name> <value>`` / ``--parsec-mca`` pairs.
+
+        Returns argv with the consumed tokens removed (the reference's
+        ``cmd_line.c`` contract of feeding MCA params from the command line).
+        """
+        out: list[str] = []
+        i = 0
+        with self._lock:
+            while i < len(argv):
+                a = argv[i]
+                if a in ("--mca", "--parsec-mca") and i + 2 < len(argv):
+                    name, value = argv[i + 1], argv[i + 2]
+                    self._cli_overrides[name] = value
+                    i += 3
+                else:
+                    out.append(a)
+                    i += 1
+            self._refresh_locked()
+        return out
+
+    def parse_paramfile(self, path: str) -> None:
+        """``name = value`` lines; ``#`` comments (cf. mca_parse_paramfile.c)."""
+        with open(path) as f:
+            with self._lock:
+                for line in f:
+                    line = line.split("#", 1)[0].strip()
+                    if not line:
+                        continue
+                    name, _, value = line.partition("=")
+                    self._file_values[name.strip()] = value.strip()
+                self._refresh_locked()
+
+    def _refresh_locked(self) -> None:
+        for p in self._params.values():
+            if p.source != "set":
+                p.value, p.source = self._resolve(p)
+
+    def dump(self) -> str:
+        """Human-readable listing (``--parsec-help`` analog, parsec.c:879-893)."""
+        with self._lock:
+            lines = []
+            for name in sorted(self._params):
+                p = self._params[name]
+                lines.append(
+                    f"{name} = {p.value!r} [{p.type}, from {p.source}] : {p.help}"
+                )
+            return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._params.clear()
+            self._cli_overrides.clear()
+            self._file_values.clear()
+
+
+params = ParamRegistry()
+
+
+def register(name: str, default: Any, help: str = "", **kw) -> Any:
+    """Register-and-read shorthand used at point of use across the tree."""
+    return params.register(name, default, help, **kw).value

@@ -1,0 +1,21 @@
+"""Data substrate: master data, device copies, arenas, repos, tile types.
+
+Rebuild of the reference's layer 2 (SURVEY §2.3 data rows): ``parsec_data_t``
+/ ``parsec_data_copy_t`` coherency, arenas, data repos, and the
+datatype/reshape system re-based on XLA relayout kernels.
+"""
+
+from .arena import Arena, ArenaDatatypeRegistry
+from .data import (ACCESS_NONE, ACCESS_READ, ACCESS_RW, ACCESS_WRITE,
+                   COHERENCY_EXCLUSIVE, COHERENCY_INVALID, COHERENCY_OWNED,
+                   COHERENCY_SHARED, Data, DataCopy, data_create)
+from .datarepo import DataRepo, DataRepoEntry
+from .datatype import TileType, convert, register_layout
+
+__all__ = [
+    "ACCESS_NONE", "ACCESS_READ", "ACCESS_RW", "ACCESS_WRITE",
+    "Arena", "ArenaDatatypeRegistry",
+    "COHERENCY_EXCLUSIVE", "COHERENCY_INVALID", "COHERENCY_OWNED",
+    "COHERENCY_SHARED", "Data", "DataCopy", "DataRepo", "DataRepoEntry",
+    "TileType", "convert", "data_create", "register_layout",
+]

@@ -1,0 +1,84 @@
+"""Arenas: bounded freelist allocators for temporary tiles.
+
+Rebuild of ``parsec/arena.{c,h}``: an arena hands out data copies of one
+(element size, alignment) class — used for communication buffers and
+DSL-allocated temporaries — with a bounded cache of released elements
+(``arena.h:49-66``: ``max_used`` caps live allocations, ``max_released`` caps
+the freelist).  ``parsec_arena_datatype_t`` pairs an arena with a datatype;
+here the :class:`TileType` plays both roles: it *is* the element class.
+
+TPU mapping: host-side arenas recycle numpy buffers; device arenas are the
+HBM tile pools managed by the device module (device/lru cache) — this class
+covers the host/comm side.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from .data import Data, DataCopy
+from .datatype import TileType
+
+
+class Arena:
+    def __init__(self, dtt: TileType, max_used: int = 0,
+                 max_released: int = 64) -> None:
+        self.dtt = dtt
+        self.max_used = max_used          # 0 = unbounded (reference default)
+        self.max_released = max_released
+        self._free: list[np.ndarray] = []
+        self._used = 0
+        self._lock = threading.Lock()
+
+    def get_copy(self, device_index: int = 0,
+                 original: Data | None = None) -> DataCopy:
+        """Allocate a tile-backed copy (``parsec_arena_get_copy``)."""
+        with self._lock:
+            if self.max_used and self._used >= self.max_used:
+                raise MemoryError(
+                    f"arena {self.dtt}: max_used={self.max_used} reached")
+            buf = self._free.pop() if self._free else None
+            self._used += 1
+        if buf is None:
+            buf = np.empty(self.dtt.shape, dtype=self.dtt.dtype)
+        d = original if original is not None else Data(nb_elts=self.dtt.nbytes)
+        copy = DataCopy(d, device_index, value=buf, dtt=self.dtt)
+        copy.arena_chunk = self
+        d.attach_copy(copy)
+        return copy
+
+    def release_copy(self, copy: DataCopy) -> None:
+        buf = copy.value
+        copy.value = None
+        with self._lock:
+            self._used -= 1
+            if isinstance(buf, np.ndarray) and len(self._free) < self.max_released:
+                self._free.append(buf)
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {"used": self._used, "cached": len(self._free)}
+
+
+class ArenaDatatypeRegistry:
+    """Per-context id -> (arena, datatype) registry, the analog of the DTD
+    arena-datatype table (``insert_function.h:99-125``)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._by_id: dict[Any, Arena] = {}
+
+    def register(self, key: Any, dtt: TileType, **kw) -> Arena:
+        with self._lock:
+            a = self._by_id.get(key)
+            if a is None:
+                a = Arena(dtt, **kw)
+                self._by_id[key] = a
+            return a
+
+    def get(self, key: Any) -> Arena:
+        with self._lock:
+            return self._by_id[key]

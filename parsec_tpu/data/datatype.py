@@ -1,0 +1,71 @@
+"""Tile type descriptors — the datatype system without MPI datatypes.
+
+The reference leans on MPI derived datatypes for pack/unpack and reshape
+(``parsec/datatype/datatype_mpi.c``, ``parsec/parsec_reshape.c``).  On TPU the
+equivalent is a *logical tile type* — shape + dtype + an optional layout
+transform — whose pack/unpack/convert operations are XLA relayout kernels
+(fused, HBM-bandwidth-bound) instead of host-side datatype engines
+(SURVEY §7 hard-part 5).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class TileType:
+    """A logical tile datatype: shape, element dtype, and layout tag.
+
+    ``layout`` distinguishes same-shape-different-layout types that need a
+    relayout on the wire (the reference's reshape-by-datatype).  Layouts are
+    opaque tags plus a pair of jittable converters registered in
+    :data:`_layout_converters`.
+    """
+
+    shape: tuple[int, ...]
+    dtype: Any = np.float32
+    layout: str = "row_major"
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64)) * np.dtype(self.dtype).itemsize
+
+    def compatible(self, other: "TileType") -> bool:
+        return self.shape == other.shape and np.dtype(self.dtype) == np.dtype(other.dtype)
+
+
+# layout tag -> (to_canonical, from_canonical); jittable array->array fns.
+_layout_converters: dict[str, tuple] = {
+    "row_major": (lambda x: x, lambda x: x),
+}
+
+
+def register_layout(tag: str, to_canonical, from_canonical) -> None:
+    _layout_converters[tag] = (to_canonical, from_canonical)
+
+
+def convert(value, src: TileType, dst: TileType):
+    """Relayout/convert a tile between datatypes.
+
+    This is the reshape kernel the comm/device layers invoke; under jit it
+    fuses into adjacent transfers.  Raises when shapes are truly
+    incompatible (no implicit resize — mirrors the reference's reshape
+    sanity checks).
+    """
+    import jax.numpy as jnp
+
+    if src.layout != "row_major":
+        value = _layout_converters[src.layout][0](value)
+    if src.shape != dst.shape:
+        if int(np.prod(src.shape)) != int(np.prod(dst.shape)):
+            raise ValueError(f"cannot reshape {src.shape} -> {dst.shape}")
+        value = jnp.reshape(value, dst.shape)
+    if np.dtype(src.dtype) != np.dtype(dst.dtype):
+        value = value.astype(dst.dtype)
+    if dst.layout != "row_major":
+        value = _layout_converters[dst.layout][1](value)
+    return value

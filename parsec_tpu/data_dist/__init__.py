@@ -1,0 +1,5 @@
+"""Data distributions (rebuild of ``parsec/data_dist/``, SURVEY §2.9)."""
+
+from .collection import DataCollection, DictCollection
+
+__all__ = ["DataCollection", "DictCollection"]

@@ -1,0 +1,81 @@
+"""Data collection interface: the distribution vtable.
+
+Rebuild of ``parsec_data_collection_t``
+(``include/parsec/data_distribution.h:26-67``): a collection maps logical keys
+to (a) the owning rank (``rank_of``), (b) the master :class:`Data`
+(``data_of``), and (c) a virtual-process hint (``vpid_of``).  Concrete
+distributions (block-cyclic etc.) live in :mod:`parsec_tpu.data_dist.matrix`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from ..data.data import Data, data_create
+from ..data.datatype import TileType
+
+
+class DataCollection:
+    """Abstract distribution (cf. the ``parsec_data_collection_t`` vtable)."""
+
+    def __init__(self, name: str = "", nodes: int = 1, myrank: int = 0) -> None:
+        self.name = name
+        self.nodes = nodes
+        self.myrank = myrank
+        self.default_dtt: TileType | None = None
+
+    def rank_of(self, *key) -> int:
+        raise NotImplementedError
+
+    def data_of(self, *key) -> Data:
+        raise NotImplementedError
+
+    def vpid_of(self, *key) -> int:
+        return 0
+
+    def key_to_string(self, *key) -> str:
+        return f"{self.name}({', '.join(map(str, key))})"
+
+
+class DictCollection(DataCollection):
+    """Host-dict-backed collection for tests and small apps: every key owned
+    by ``rank_of_fn`` (default rank 0), data created lazily from
+    ``init_fn(key)`` or zeros of ``dtt``."""
+
+    def __init__(self, name: str = "dict", dtt: TileType | None = None,
+                 init_fn: Any = None, nodes: int = 1, myrank: int = 0,
+                 rank_of_fn: Any = None) -> None:
+        super().__init__(name, nodes, myrank)
+        self.default_dtt = dtt
+        self._init_fn = init_fn
+        self._rank_of_fn = rank_of_fn
+        self._store: dict[tuple, Data] = {}
+        self._lock = threading.Lock()
+
+    def rank_of(self, *key) -> int:
+        if self._rank_of_fn is not None:
+            return self._rank_of_fn(*key)
+        return 0
+
+    def data_of(self, *key) -> Data:
+        with self._lock:
+            d = self._store.get(key)
+            if d is None:
+                if self._init_fn is not None:
+                    value = np.asarray(self._init_fn(*key))
+                elif self.default_dtt is not None:
+                    value = np.zeros(self.default_dtt.shape,
+                                     dtype=self.default_dtt.dtype)
+                else:
+                    raise KeyError(f"no data and no init for {key}")
+                d = data_create(value, key=(self.name,) + key,
+                                dtt=self.default_dtt, dc=self)
+                self._store[key] = d
+            return d
+
+    def __contains__(self, key: tuple) -> bool:
+        with self._lock:
+            return key in self._store

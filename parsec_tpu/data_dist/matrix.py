@@ -1,0 +1,220 @@
+"""Tiled-matrix descriptors and block-cyclic distributions.
+
+Rebuild of ``parsec/data_dist/matrix/`` (SURVEY §2.9): the
+``parsec_tiled_matrix_t`` descriptor (tile sizes mb×nb, matrix sizes lm×ln,
+submatrix origin i/j, tile counts mt×nt) and the workhorse two-dimensional
+P×Q block-cyclic distribution (``two_dim_rectangle_cyclic.c``) with KP/KQ
+supertiles, plus the symmetric (lower/upper-triangular storage) and tabular
+(arbitrary tile→rank table) variants.
+
+TPU mapping: tiles are host numpy arrays staged into HBM by the device module
+on first touch; a block-cyclic (P, Q) grid over pod chips gives the same
+communication pattern the reference uses over MPI ranks, with the ICI mesh as
+the PxQ torus.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+import numpy as np
+
+from ..data.data import Data, data_create
+from ..data.datatype import TileType
+from .collection import DataCollection
+
+# matrix element types (cf. matrix.h mtype enum)
+MATRIX_BYTE = np.int8
+MATRIX_INT = np.int32
+MATRIX_FLOAT = np.float32
+MATRIX_DOUBLE = np.float64
+
+
+class TiledMatrix(DataCollection):
+    """Base tiled-matrix collection (cf. ``parsec_tiled_matrix_t``).
+
+    Keys are tile coordinates ``(m, n)`` with ``0 <= m < mt``, ``0 <= n < nt``.
+    """
+
+    def __init__(self, name: str, lm: int, ln: int, mb: int, nb: int,
+                 dtype: Any = np.float32, nodes: int = 1, myrank: int = 0,
+                 init_fn: Callable | None = None) -> None:
+        super().__init__(name, nodes, myrank)
+        self.lm, self.ln = lm, ln
+        self.mb, self.nb = mb, nb
+        self.mt = (lm + mb - 1) // mb
+        self.nt = (ln + nb - 1) // nb
+        self.dtype = np.dtype(dtype)
+        self.default_dtt = TileType((mb, nb), dtype)
+        self._init_fn = init_fn
+        self._store: dict[tuple, Data] = {}
+        self._lock = threading.Lock()
+
+    # -- tile geometry -------------------------------------------------------
+    def tile_shape(self, m: int, n: int) -> tuple[int, int]:
+        """Edge tiles may be ragged; interior tiles are (mb, nb)."""
+        h = min(self.mb, self.lm - m * self.mb)
+        w = min(self.nb, self.ln - n * self.nb)
+        return (h, w)
+
+    def rank_of(self, m: int, n: int) -> int:
+        return 0
+
+    def vpid_of(self, m: int, n: int) -> int:
+        return 0
+
+    def data_of(self, m: int, n: int) -> Data:
+        with self._lock:
+            d = self._store.get((m, n))
+            if d is None:
+                shape = self.tile_shape(m, n)
+                if self._init_fn is not None:
+                    value = np.asarray(self._init_fn(m, n, shape),
+                                       dtype=self.dtype)
+                else:
+                    value = np.zeros(shape, dtype=self.dtype)
+                d = data_create(value, key=(self.name, m, n),
+                                dtt=TileType(shape, self.dtype), dc=self)
+                self._store[(m, n)] = d
+            return d
+
+    # -- whole-matrix conversion (test/bench convenience) -------------------
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros((self.lm, self.ln), dtype=self.dtype)
+        for m in range(self.mt):
+            for n in range(self.nt):
+                if self.rank_of(m, n) != self.myrank and self.nodes > 1:
+                    continue
+                t = self.data_of(m, n).newest_copy().value
+                out[m * self.mb:m * self.mb + t.shape[0],
+                    n * self.nb:n * self.nb + t.shape[1]] = np.asarray(t)
+        return out
+
+    @classmethod
+    def from_dense(cls, name: str, a: np.ndarray, mb: int, nb: int,
+                   **kw) -> "TiledMatrix":
+        def init(m, n, shape):
+            return a[m * mb:m * mb + shape[0], n * nb:n * nb + shape[1]]
+
+        return cls(name, a.shape[0], a.shape[1], mb, nb, dtype=a.dtype,
+                   init_fn=init, **kw)
+
+
+class TwoDimBlockCyclic(TiledMatrix):
+    """P×Q block-cyclic distribution with KP/KQ supertiles
+    (``parsec_matrix_block_cyclic_init``)."""
+
+    def __init__(self, name: str, lm: int, ln: int, mb: int, nb: int,
+                 P: int = 1, Q: int = 1, kp: int = 1, kq: int = 1,
+                 **kw) -> None:
+        nodes = kw.pop("nodes", P * Q)
+        super().__init__(name, lm, ln, mb, nb, nodes=nodes, **kw)
+        self.P, self.Q = P, Q
+        self.kp, self.kq = kp, kq
+
+    def rank_of(self, m: int, n: int) -> int:
+        p = (m // self.kp) % self.P
+        q = (n // self.kq) % self.Q
+        return p * self.Q + q
+
+    def vpid_of(self, m: int, n: int) -> int:
+        return 0
+
+
+class SymTwoDimBlockCyclic(TwoDimBlockCyclic):
+    """Symmetric/triangular storage: only tiles with m >= n (lower) or
+    m <= n (upper) exist (``sym_two_dim_rectangle_cyclic.c``)."""
+
+    LOWER, UPPER = 0, 1
+
+    def __init__(self, *args, uplo: int = 0, **kw) -> None:
+        super().__init__(*args, **kw)
+        self.uplo = uplo
+
+    def _check(self, m: int, n: int) -> None:
+        if self.uplo == self.LOWER and n > m:
+            raise KeyError(f"upper tile ({m},{n}) of a lower-sym matrix")
+        if self.uplo == self.UPPER and m > n:
+            raise KeyError(f"lower tile ({m},{n}) of an upper-sym matrix")
+
+    def data_of(self, m: int, n: int) -> Data:
+        self._check(m, n)
+        return super().data_of(m, n)
+
+    def rank_of(self, m: int, n: int) -> int:
+        self._check(m, n)
+        return super().rank_of(m, n)
+
+
+class TwoDimTabular(TiledMatrix):
+    """Arbitrary tile→rank table (``two_dim_tabular.c``) — the substrate for
+    expert-parallel-style irregular placements."""
+
+    def __init__(self, name: str, lm: int, ln: int, mb: int, nb: int,
+                 rank_table: Callable[[int, int], int] | dict | None = None,
+                 **kw) -> None:
+        super().__init__(name, lm, ln, mb, nb, **kw)
+        self._table = rank_table or (lambda m, n: 0)
+
+    def rank_of(self, m: int, n: int) -> int:
+        if callable(self._table):
+            return self._table(m, n)
+        return self._table[(m, n)]
+
+
+class VectorTwoDimCyclic(DataCollection):
+    """1-D cyclic vector of segments (``vector_two_dim_cyclic.c``)."""
+
+    def __init__(self, name: str, lm: int, mb: int, P: int = 1,
+                 dtype: Any = np.float32, init_fn: Callable | None = None,
+                 **kw) -> None:
+        super().__init__(name, nodes=kw.pop("nodes", P), myrank=kw.pop("myrank", 0))
+        self.lm, self.mb = lm, mb
+        self.mt = (lm + mb - 1) // mb
+        self.P = P
+        self.dtype = np.dtype(dtype)
+        self.default_dtt = TileType((mb,), dtype)
+        self._init_fn = init_fn
+        self._store: dict[tuple, Data] = {}
+        self._lock = threading.Lock()
+
+    def rank_of(self, m: int) -> int:
+        return m % self.P
+
+    def data_of(self, m: int) -> Data:
+        with self._lock:
+            d = self._store.get((m,))
+            if d is None:
+                size = min(self.mb, self.lm - m * self.mb)
+                value = (np.asarray(self._init_fn(m, size), dtype=self.dtype)
+                         if self._init_fn else np.zeros(size, self.dtype))
+                d = data_create(value, key=(self.name, m),
+                                dtt=TileType((size,), self.dtype), dc=self)
+                self._store[(m,)] = d
+            return d
+
+
+class HashDataDist(DataCollection):
+    """Generic hash-keyed distribution (``hash_datadist.c``): arbitrary keys,
+    user rank function, lazily-registered data."""
+
+    def __init__(self, name: str = "hash", nodes: int = 1, myrank: int = 0,
+                 rank_fn: Callable[..., int] | None = None) -> None:
+        super().__init__(name, nodes, myrank)
+        self._rank_fn = rank_fn or (lambda *k: 0)
+        self._store: dict[tuple, Data] = {}
+        self._lock = threading.Lock()
+
+    def register(self, key: tuple, value: np.ndarray) -> Data:
+        with self._lock:
+            d = data_create(np.asarray(value), key=(self.name,) + key, dc=self)
+            self._store[key] = d
+            return d
+
+    def rank_of(self, *key) -> int:
+        return self._rank_fn(*key)
+
+    def data_of(self, *key) -> Data:
+        with self._lock:
+            return self._store[key]

@@ -1,0 +1,5 @@
+"""Device layer (rebuild of ``parsec/mca/device/``, SURVEY §2.5)."""
+
+from .device import CPUDevice, Device, DeviceRegistry, registry
+
+__all__ = ["CPUDevice", "Device", "DeviceRegistry", "registry"]

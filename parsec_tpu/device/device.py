@@ -1,0 +1,125 @@
+"""Device registry, statistics, and best-device selection.
+
+Rebuild of ``parsec/mca/device/device.{c,h}`` (SURVEY §2.5): devices register
+with the process-global registry; each carries transfer/execution statistics
+(``device.h:151-156``), per-precision gflops ratings and a load accumulator
+(``device.h:161-166``); ``best_device`` implements
+``parsec_get_best_device`` = argmin over (device_load + time_estimate(task))
+with task classes contributing ``time_estimate`` functions
+(``parsec_internal.h:441``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from ..core.params import params as _params
+from ..core.info import InfoObjectArray
+
+
+class Device:
+    """Base device module (cf. ``parsec_device_module_t``)."""
+
+    def __init__(self, name: str, device_type: str) -> None:
+        self.name = name
+        self.type = device_type          # DEV_CPU / DEV_TPU / ...
+        self.device_index = -1
+        self.enabled = True
+        # statistics (device.h:151-156)
+        self.executed_tasks = 0
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.bytes_d2d = 0
+        # capacity model (device.h:161-166)
+        self.gflops_fp16 = 1.0
+        self.gflops_fp32 = 1.0
+        self.gflops_fp64 = 1.0
+        self.device_load = 0.0
+        self._load_lock = threading.Lock()
+        self.infos = InfoObjectArray(self)
+
+    # load accounting around task execution
+    def load_add(self, delta: float) -> None:
+        with self._load_lock:
+            self.device_load += delta
+
+    def taskpool_register(self, taskpool: Any) -> None:
+        """Hook for per-taskpool device state (kernel resolution etc.)."""
+
+    def memory_register(self, buffer: Any) -> Any:
+        return buffer
+
+    def memory_unregister(self, handle: Any) -> None:
+        pass
+
+    def flush_cache(self) -> None:
+        pass
+
+    def stats_reset(self) -> dict[str, float]:
+        s = self.stats()
+        self.executed_tasks = 0
+        self.bytes_in = self.bytes_out = self.bytes_d2d = 0
+        return s
+
+    def stats(self) -> dict[str, float]:
+        return {
+            "executed_tasks": self.executed_tasks,
+            "bytes_in": self.bytes_in,
+            "bytes_out": self.bytes_out,
+            "bytes_d2d": self.bytes_d2d,
+            "device_load": self.device_load,
+        }
+
+
+class CPUDevice(Device):
+    """Host device: chores run inline on the worker thread."""
+
+    def __init__(self) -> None:
+        super().__init__("cpu", "cpu")
+
+
+class DeviceRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.devices: list[Device] = []
+
+    def add(self, dev: Device) -> Device:
+        with self._lock:
+            dev.device_index = len(self.devices)
+            self.devices.append(dev)
+        return dev
+
+    def by_type(self, device_type: str) -> list[Device]:
+        return [d for d in self.devices if d.type == device_type and d.enabled]
+
+    def get(self, index: int) -> Device:
+        return self.devices[index]
+
+    def best_device(self, task: Any, device_type: str | None = None) -> Device | None:
+        """``parsec_get_best_device``: min (load + time_estimate)."""
+        cands = [d for d in self.devices
+                 if d.enabled and (device_type is None or d.type == device_type)]
+        if not cands:
+            return None
+        te = task.task_class.time_estimate
+
+        def cost(d: Device) -> float:
+            est = te(task, d) if te is not None else 0.0
+            return d.device_load + est
+
+        return min(cands, key=cost)
+
+    def dump_statistics(self) -> dict[str, dict[str, float]]:
+        return {d.name: d.stats() for d in self.devices}
+
+    def reset(self) -> None:
+        with self._lock:
+            self.devices = []
+
+
+registry = DeviceRegistry()
+registry.add(CPUDevice())
+
+_params.register("device_tpu_enabled", True,
+                        "enable the TPU device module")

@@ -1,0 +1,35 @@
+"""Kernel incarnation registry.
+
+The TPU analog of the reference's ``dyld=`` dynamic body resolution
+(``find_incarnation``, ``device_gpu.c:201``: dlopen/dlsym per device): device
+bodies are registered by name and device type; PTG/DTD chores resolve them at
+dispatch.  TPU kernels are jitted XLA/Pallas callables; registration usually
+happens at module import of :mod:`parsec_tpu.ops`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+_lock = threading.Lock()
+_kernels: dict[tuple[str, str], Callable] = {}
+
+
+def register_kernel(name: str, device_type: str, fn: Callable) -> Callable:
+    with _lock:
+        _kernels[(name, device_type)] = fn
+    return fn
+
+
+def find_incarnation(name: str, device: Any) -> Callable | None:
+    with _lock:
+        fn = _kernels.get((name, device.type))
+        if fn is None:
+            fn = _kernels.get((name, "*"))
+        return fn
+
+
+def registered() -> list[tuple[str, str]]:
+    with _lock:
+        return list(_kernels)

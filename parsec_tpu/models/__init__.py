@@ -1,0 +1,5 @@
+"""Library taskpools / flagship applications built on the runtime."""
+
+from . import tiled_gemm
+
+__all__ = ["tiled_gemm"]

@@ -1,0 +1,88 @@
+"""Flagship: tiled GEMM as a PTG taskpool (+ fused single-program executor).
+
+The rebuild's analog of the reference's GEMM benchmarks
+(``tests/dsl/dtd/dtd_test_simple_gemm.c``, ``tests/runtime/cuda/stress.jdf``)
+and the BASELINE.md target config (PTG tiled-GEMM, N=16384, nb=512).
+
+Two execution paths, by design (TPU-first):
+
+1. :func:`tiled_gemm_ptg` — the dynamic-runtime path: a PTG taskpool
+   GEMM(m,n,k) whose C-flow chains along k; tiles stage into HBM through the
+   TPU device module; correctness/irregular-shape path.
+2. :func:`tiled_gemm_fused` — the compiled path: the same dataflow lowered to
+   one XLA program (single chip: one MXU-tiled matmul; multi-chip: shard_map
+   over a mesh in :mod:`parsec_tpu.parallel`).  On TPU the compiler's
+   schedule of the regular k-chain beats any host-dispatched task loop, so
+   the runtime treats "fused" as just another incarnation of the taskpool.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import ptg
+from ..data_dist.matrix import TiledMatrix
+from ..ops import gemm as gemm_ops
+
+
+def tiled_gemm_ptg(A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
+                   devices: str = "auto") -> ptg.PTGTaskpool:
+    """Build the GEMM(m,n,k) PTG over tiled matrices: C += A·B.
+
+    Flows (positionally fixed for the kernel bodies): 0=A READ, 1=B READ,
+    2=C RW chained over k.
+    """
+    MT, NT, KT = C.mt, C.nt, A.nt
+    assert A.mt == MT and B.nt == NT and B.mt == KT
+
+    p = ptg.PTGBuilder("tiled_gemm", A=A, B=B, C=C, MT=MT, NT=NT, KT=KT)
+    t = p.task("GEMM",
+               m=ptg.span(0, lambda g, l: g.MT - 1),
+               n=ptg.span(0, lambda g, l: g.NT - 1),
+               k=ptg.span(0, lambda g, l: g.KT - 1))
+    t.affinity("C", lambda g, l: (l.m, l.n))
+    t.priority(lambda g, l: g.KT - l.k)   # deeper chains first
+    fa = t.flow("A", ptg.READ)
+    fa.input(data=("A", lambda g, l: (l.m, l.k)))
+    fb = t.flow("B", ptg.READ)
+    fb.input(data=("B", lambda g, l: (l.k, l.n)))
+    fc = t.flow("C", ptg.RW)
+    fc.input(data=("C", lambda g, l: (l.m, l.n)), guard=lambda g, l: l.k == 0)
+    fc.input(pred=("GEMM", "C", lambda g, l: {"m": l.m, "n": l.n, "k": l.k - 1}),
+             guard=lambda g, l: l.k > 0)
+    fc.output(succ=("GEMM", "C", lambda g, l: {"m": l.m, "n": l.n, "k": l.k + 1}),
+              guard=lambda g, l: l.k < g.KT - 1)
+    fc.output(data=("C", lambda g, l: (l.m, l.n)),
+              guard=lambda g, l: l.k == g.KT - 1)
+    # flops-based time estimate feeds best-device selection
+    flops = 2.0 * A.mb * C.nb * A.nb
+    t.time_estimate(lambda task, dev: flops / (dev.gflops_fp32 * 1e9))
+    if devices in ("auto", "tpu"):
+        t.body(device="tpu", dyld="gemm")
+    if devices in ("auto", "cpu"):
+        t.body(_cpu_wrap, device="cpu")
+    return p.build()
+
+
+def _cpu_wrap(es: Any, task: Any, g: Any, l: Any) -> None:
+    gemm_ops.gemm_cpu_body(es, task)
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def _fused_gemm(a, b, c, precision=None):
+    return c + jnp.dot(a, b, preferred_element_type=c.dtype,
+                       precision=precision)
+
+
+def tiled_gemm_fused(a: Any, b: Any, c: Any, precision: Any = None) -> Any:
+    """One-program lowering of the GEMM taskpool for dense operands."""
+    return _fused_gemm(a, b, c, precision=precision)
+
+
+def gemm_flops(M: int, N: int, K: int) -> float:
+    return 2.0 * M * N * K

@@ -1,0 +1,5 @@
+"""Kernel library: XLA/Pallas incarnations for task bodies."""
+
+from . import gemm
+
+__all__ = ["gemm"]

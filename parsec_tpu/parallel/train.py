@@ -1,0 +1,57 @@
+"""Composed distributed training step — the parallelism-pack showcase.
+
+SURVEY §2.12 requires DP/TP/PP/SP/EP to be first-class derived schedules.
+This module provides the *compiled* (SPMD) realization: a training step
+jitted over a ``jax.sharding.Mesh`` via ``shard_map``, with XLA collectives
+riding ICI.  The dynamic-runtime realization of the same patterns (halo/ring
+PTG taskpools) lives beside it in this package.
+
+Current step: data-parallel batch sharding (``dp``) × megatron-style tensor
+parallelism (``tp``: column-sharded W1, row-sharded W2, one ``psum`` per
+block).  The sequence-parallel ring-attention and pipeline/expert stages are
+layered onto the same mesh as they land in this package.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def init_params(key: Any, d_model: int, d_ff: int) -> dict:
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (d_model, d_ff), jnp.float32) * 0.02,
+        "w2": jax.random.normal(k2, (d_ff, d_model), jnp.float32) * 0.02,
+    }
+
+
+def make_train_step(mesh: Mesh, lr: float = 0.1):
+    """One SGD step of a TP-sharded MLP block over dp×tp."""
+    param_specs = {"w1": P(None, "tp"), "w2": P("tp", None)}
+
+    def local_loss(params: dict, x, y):
+        h = jax.nn.relu(x @ params["w1"])        # [b, s, d_ff/tp]
+        o = lax.psum(h @ params["w2"], "tp")     # row-parallel matmul reduce
+        return jnp.mean((o - y) ** 2)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(param_specs, P("dp"), P("dp")),
+        out_specs=(param_specs, P()),
+        check_rep=False,
+    )
+    def step(params: dict, x, y):
+        loss, grads = jax.value_and_grad(local_loss)(params, x, y)
+        # data-parallel gradient reduction over dp (tp shards stay sharded)
+        grads = jax.tree.map(lambda g: lax.pmean(g, "dp"), grads)
+        new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return new_params, lax.pmean(loss, "dp")
+
+    return jax.jit(step)

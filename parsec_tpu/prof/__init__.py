@@ -1,0 +1,6 @@
+"""Observability (rebuild of PINS/profiling, SURVEY §2.10, §5.1)."""
+
+from . import pins
+from .pins import PinsEvent
+
+__all__ = ["PinsEvent", "pins"]

@@ -1,0 +1,66 @@
+"""PINS: instrumentation callback chains on runtime events.
+
+Rebuild of ``parsec/mca/pins/pins.h:26-120``: modules register begin/end
+callbacks on runtime events (SELECT, PREPARE_INPUT, EXEC, COMPLETE_EXEC,
+SCHEDULE, RELEASE_DEPS, ...); the runtime fires them from fixed points in the
+scheduling loop.  Dispatch cost when nothing is registered is one attribute
+load + truth test per site (the macro-compiled-out analog).
+"""
+
+from __future__ import annotations
+
+import threading
+from enum import IntEnum
+from typing import Any, Callable
+
+
+class PinsEvent(IntEnum):
+    SELECT_BEGIN = 0
+    SELECT_END = 1
+    PREPARE_INPUT_BEGIN = 2
+    PREPARE_INPUT_END = 3
+    EXEC_BEGIN = 4
+    EXEC_END = 5
+    COMPLETE_EXEC_BEGIN = 6
+    COMPLETE_EXEC_END = 7
+    SCHEDULE_BEGIN = 8
+    SCHEDULE_END = 9
+    RELEASE_DEPS_BEGIN = 10
+    RELEASE_DEPS_END = 11
+    ACTIVATE_CB_BEGIN = 12
+    ACTIVATE_CB_END = 13
+    DATA_FLUSH_BEGIN = 14
+    DATA_FLUSH_END = 15
+    TASKPOOL_INIT = 16
+    TASKPOOL_FINI = 17
+
+
+Callback = Callable[[Any, Any], None]   # (execution_stream_or_none, payload)
+
+_lock = threading.Lock()
+_chains: dict[int, list[Callback]] = {}
+enabled = False
+
+
+def register(event: PinsEvent, cb: Callback) -> None:
+    global enabled
+    with _lock:
+        _chains.setdefault(int(event), []).append(cb)
+        enabled = True
+
+
+def unregister(event: PinsEvent, cb: Callback) -> None:
+    global enabled
+    with _lock:
+        lst = _chains.get(int(event), [])
+        if cb in lst:
+            # copy-on-write: fire() iterates these lists unlocked
+            _chains[int(event)] = [c for c in lst if c is not cb]
+        enabled = any(_chains.values())
+
+
+def fire(event: PinsEvent, es: Any = None, payload: Any = None) -> None:
+    if not enabled:
+        return
+    for cb in _chains.get(int(event), ()):  # snapshot-free: append-only lists
+        cb(es, payload)

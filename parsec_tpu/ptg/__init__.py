@@ -1,0 +1,7 @@
+"""PTG DSL front-end (rebuild of ``parsec/interfaces/ptg/``, SURVEY §2.7)."""
+
+from .dsl import (CTL, READ, RW, WRITE, FlowBuilder, PTGBuilder, PTGTaskpool,
+                  TaskClassBuilder, span)
+
+__all__ = ["CTL", "READ", "RW", "WRITE", "FlowBuilder", "PTGBuilder",
+           "PTGTaskpool", "TaskClassBuilder", "span"]

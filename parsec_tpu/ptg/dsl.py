@@ -1,0 +1,311 @@
+"""PTG: the Parameterized Task Graph DSL, algebraic builder form.
+
+Rebuild of the reference's JDF front-end (SURVEY §2.7) as a Python-embedded
+algebraic API instead of a flex/bison→C compiler: a taskpool is described
+problem-size-independently by task classes with
+
+- *parameters* spanning an execution space (range expressions that may depend
+  on globals and on previously-bound parameters — triangular spaces work),
+- a *data affinity* (``: A(k)``) fixing the owning rank,
+- *flows* (``RW``/``READ``/``WRITE``/``CTL``) with guarded input/output
+  dependency arrows to other task classes or to the collection,
+- per-device *bodies* (chores), and an optional priority expression.
+
+The builder materializes :class:`~parsec_tpu.runtime.task.TaskClass` objects
+and a :class:`PTGTaskpool` whose startup enumerates the execution space and
+schedules the tasks whose IN-dep masks are empty (the generated
+``startup``/``internal_init`` contract, ``jdf2c.c:3035``/``:3431``).  The JDF
+*textual* front-end (:mod:`parsec_tpu.ptg.jdf`) parses into this same builder,
+so both front-ends share one backend — mirroring ``parsec_ptgpp`` emitting
+code against one runtime ABI.
+
+Guard/range/assignment expressions are callables ``fn(g, l)`` receiving
+read-only namespaces of globals and locals; the JDF parser compiles its
+expression strings into exactly these.
+"""
+
+from __future__ import annotations
+
+import itertools
+from types import SimpleNamespace
+from typing import Any, Callable, Iterable, Sequence
+
+from ..data.data import ACCESS_READ, ACCESS_RW, ACCESS_WRITE
+from ..runtime.task import (FLOW_CTL, HOOK_RETURN_DONE, Chore, Dep, Flow,
+                            TaskClass)
+from ..runtime.taskpool import Taskpool
+
+READ = ACCESS_READ
+WRITE = ACCESS_WRITE
+RW = ACCESS_RW
+CTL = FLOW_CTL
+
+
+class _NS(SimpleNamespace):
+    def __getitem__(self, k):
+        return getattr(self, k)
+
+
+def _ns(d: dict) -> _NS:
+    return _NS(**d)
+
+
+class FlowBuilder:
+    def __init__(self, tcb: "TaskClassBuilder", name: str, access: Any,
+                 dtt: Any = None) -> None:
+        self._tcb = tcb
+        self.name = name
+        self.access = access
+        self.dtt = dtt
+        self._deps_in: list[Dep] = []
+        self._deps_out: list[Dep] = []
+
+    def input(self, pred: tuple | None = None, data: tuple | None = None,
+              guard: Callable | None = None, dtt: Any = None) -> "FlowBuilder":
+        """Add an input arrow.
+
+        ``pred=(class_name, flow_name, params_fn)`` for a task predecessor;
+        ``data=(collection_or_name, key_fn)`` for a direct collection read.
+        ``params_fn(g, l) -> dict`` binds the predecessor's locals;
+        ``key_fn(g, l) -> tuple`` the collection key.
+        """
+        self._deps_in.append(self._tcb._mk_dep(pred, data, guard, dtt))
+        return self
+
+    def output(self, succ: tuple | None = None, data: tuple | None = None,
+               guard: Callable | None = None, dtt: Any = None) -> "FlowBuilder":
+        self._deps_out.append(self._tcb._mk_dep(succ, data, guard, dtt))
+        return self
+
+    def _build(self) -> Flow:
+        return Flow(self.name, self.access, deps_in=self._deps_in,
+                    deps_out=self._deps_out, dtt=self.dtt)
+
+
+class TaskClassBuilder:
+    def __init__(self, ptg: "PTGBuilder", name: str,
+                 params: dict[str, Callable]) -> None:
+        self._ptg = ptg
+        self.name = name
+        # param name -> fn(g, l) -> iterable (l holds previously-bound params)
+        self.param_ranges = dict(params)
+        self._flows: list[FlowBuilder] = []
+        self._chores: list[Chore] = []
+        self._affinity: Callable | None = None
+        self._priority: Callable | None = None
+        self._time_estimate: Callable | None = None
+
+    # -- structure ----------------------------------------------------------
+    def affinity(self, collection: Any, key_fn: Callable) -> "TaskClassBuilder":
+        dc_get = self._ptg._dc_getter(collection)
+
+        def aff(locals_: dict) -> tuple:
+            g, l = self._ptg._g_ns(), _ns(locals_)
+            return dc_get(), key_fn(g, l)
+
+        self._affinity = aff
+        return self
+
+    def flow(self, name: str, access: Any, dtt: Any = None) -> FlowBuilder:
+        fb = FlowBuilder(self, name, access, dtt)
+        self._flows.append(fb)
+        return fb
+
+    def priority(self, fn: Callable) -> "TaskClassBuilder":
+        g_ns = self._ptg._g_ns
+        self._priority = lambda locals_: int(fn(g_ns(), _ns(locals_)))
+        return self
+
+    def time_estimate(self, fn: Callable) -> "TaskClassBuilder":
+        self._time_estimate = fn
+        return self
+
+    def body(self, fn: Callable | None = None, device: str = "cpu",
+             dyld: str | None = None,
+             evaluate: Callable | None = None) -> Any:
+        """Attach a body for ``device`` (multiple BODY...END analog).
+
+        CPU bodies are callables ``fn(es, task, g, l)``; device bodies may
+        instead name a kernel-registry entry via ``dyld`` (the JDF ``dyld=``
+        incarnation contract).  Usable as a decorator: ``@tc.body``.
+        """
+        def attach(f: Callable | None) -> Callable | None:
+            if device == "cpu":
+                hook = self._wrap_cpu_body(f)
+            else:
+                from ..device.hooks import make_device_hook
+                hook = make_device_hook(device, f, dyld, self._ptg)
+            self._chores.append(Chore(device, hook=hook, evaluate=evaluate,
+                                      dyld=dyld))
+            return f
+
+        if fn is None and dyld is not None:
+            return attach(None)
+        if fn is None:
+            return attach  # decorator form
+        return attach(fn)
+
+    def _wrap_cpu_body(self, f: Callable) -> Callable:
+        g_ns = self._ptg._g_ns
+
+        def hook(es: Any, task: Any) -> int:
+            rc = f(es, task, g_ns(), _ns(task.locals))
+            return HOOK_RETURN_DONE if rc is None else rc
+
+        return hook
+
+    # -- helpers ------------------------------------------------------------
+    def _mk_dep(self, ref: tuple | None, data: tuple | None,
+                guard: Callable | None, dtt: Any) -> Dep:
+        g_ns = self._ptg._g_ns
+        gfn = None
+        if guard is not None:
+            gfn = lambda locals_: guard(g_ns(), _ns(locals_))
+        if ref is not None:
+            cls_name, flow_name, params_fn = ref
+            tparams = lambda locals_: params_fn(g_ns(), _ns(locals_))
+            return Dep(guard=gfn, target_class=cls_name,
+                       target_flow=flow_name, target_params=tparams, dtt=dtt)
+        if data is not None:
+            collection, key_fn = data
+            dc_get = self._ptg._dc_getter(collection)
+
+            def data_ref(locals_: dict) -> tuple:
+                key = key_fn(g_ns(), _ns(locals_))
+                if not isinstance(key, tuple):
+                    key = (key,)
+                return dc_get(), key
+
+            return Dep(guard=gfn, data_ref=data_ref, dtt=dtt)
+        # pure CTL arrow with neither: invalid
+        raise ValueError("dep needs a task ref or a data ref")
+
+    def _enumerate_space(self) -> Iterable[dict]:
+        """Yield every locals assignment in the execution space."""
+        g = self._ptg._g_ns()
+        names = list(self.param_ranges)
+
+        def rec(i: int, partial: dict):
+            if i == len(names):
+                yield dict(partial)
+                return
+            name = names[i]
+            for v in self.param_ranges[name](g, _ns(partial)):
+                partial[name] = v
+                yield from rec(i + 1, partial)
+            partial.pop(name, None)
+
+        yield from rec(0, {})
+
+    def _build(self) -> TaskClass:
+        return TaskClass(
+            self.name,
+            params=list(self.param_ranges),
+            flows=[fb._build() for fb in self._flows],
+            chores=list(self._chores),
+            affinity=self._affinity,
+            priority=self._priority,
+            time_estimate=self._time_estimate,
+        )
+
+
+class PTGTaskpool(Taskpool):
+    """A taskpool generated from a PTG description."""
+
+    def __init__(self, name: str, builder: "PTGBuilder") -> None:
+        super().__init__(name=name)
+        self._builder = builder
+        self._tc_builders: dict[str, TaskClassBuilder] = {}
+
+    def nb_local_tasks(self) -> int:
+        """Count tasks whose affinity lands on this rank (generated
+        ``nb_local_tasks_fn`` analog)."""
+        my_rank = self.context.my_rank if self.context else 0
+        multi = self.context is not None and self.context.nb_ranks > 1
+        n = 0
+        for tc in self.task_classes:
+            tcb = self._tc_builders[tc.name]
+            for locals_ in tcb._enumerate_space():
+                if multi and tc.affinity is not None:
+                    dc, key = tc.affinity(locals_)
+                    if not isinstance(key, tuple):
+                        key = (key,)
+                    if dc.rank_of(*key) != my_rank:
+                        continue
+                n += 1
+        return n
+
+    def startup(self, context: Any) -> list:
+        """Enumerate initially-ready local tasks (empty IN-dep mask)."""
+        from ..runtime.task import Task
+        multi = context.nb_ranks > 1
+        out = []
+        for tc in self.task_classes:
+            tcb = self._tc_builders[tc.name]
+            for locals_ in tcb._enumerate_space():
+                if tc.input_dep_mask(locals_) != 0:
+                    continue
+                if multi and tc.affinity is not None:
+                    dc, key = tc.affinity(locals_)
+                    if not isinstance(key, tuple):
+                        key = (key,)
+                    if dc.rank_of(*key) != my_rank_of(context):
+                        continue
+                prio = tc.priority(locals_) if tc.priority else 0
+                t = Task(self, tc, locals_, priority=prio)
+                t.status = "ready"
+                out.append(t)
+        return out
+
+
+def my_rank_of(context: Any) -> int:
+    return context.my_rank
+
+
+class PTGBuilder:
+    """Top-level builder: globals + task classes → :class:`PTGTaskpool`.
+
+    Globals mirror JDF globals (problem sizes, collections); they are late
+    bound so a built taskpool template can be re-parameterized.
+    """
+
+    def __init__(self, name: str, **globals_) -> None:
+        self.name = name
+        self.globals = dict(globals_)
+        self._classes: list[TaskClassBuilder] = []
+
+    def global_(self, **kw) -> "PTGBuilder":
+        self.globals.update(kw)
+        return self
+
+    def _g_ns(self) -> _NS:
+        return _ns(self.globals)
+
+    def _dc_getter(self, collection: Any) -> Callable[[], Any]:
+        if isinstance(collection, str):
+            return lambda: self.globals[collection]
+        return lambda: collection
+
+    def task(self, name: str, **params: Callable) -> TaskClassBuilder:
+        tcb = TaskClassBuilder(self, name, params)
+        self._classes.append(tcb)
+        return tcb
+
+    def build(self) -> PTGTaskpool:
+        tp = PTGTaskpool(self.name, self)
+        for tcb in self._classes:
+            tc = tp.add_task_class(tcb._build())
+            tp._tc_builders[tc.name] = tcb
+        return tp
+
+
+# convenience range constructors mirroring JDF "low .. high" syntax
+def span(low: Callable | int, high: Callable | int, step: int = 1) -> Callable:
+    """Inclusive range ``low .. high`` like JDF execution-space ranges."""
+
+    def rng(g: _NS, l: _NS) -> range:
+        lo = low(g, l) if callable(low) else low
+        hi = high(g, l) if callable(high) else high
+        return range(lo, hi + 1, step)
+
+    return rng

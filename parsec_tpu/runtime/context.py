@@ -1,0 +1,249 @@
+"""The runtime context: worker threads, scheduler, lifecycle.
+
+Rebuild of ``parsec_context_t`` + ``parsec_init`` / ``parsec_fini``
+(``parsec.c:370-901``, SURVEY §3.1) and the enqueue/start/wait API
+(``runtime.h:155-712``): a context owns virtual processes of execution
+streams (worker threads), a scheduler module selected through MCA, the device
+registry, the dependency-tracking table, and (when distributed) the comm
+engine.  Workers park on a start barrier until ``context_start`` releases
+them, then run the §3.3 hot loop until every enqueued taskpool terminates.
+
+Single-threaded contexts (``nb_cores=0``) are first-class: the caller's thread
+drives progress from ``wait()`` — the analog of the master-thread funneled
+path (``scheduling.c:775-784``) and the mode the TPU device manager favors
+(device batching makes worker parallelism less critical than on CPU).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from ..core.params import params as _params
+from ..core.backoff import Backoff
+from ..core.mca import repository
+from ..prof import pins
+from ..prof.pins import PinsEvent
+from .deps import DependencyTracking
+from .scheduling import (ExecutionStream, VirtualProcess, schedule_tasks,
+                         select_task, task_progress)
+from .taskpool import Taskpool
+
+_params.register("runtime_num_cores", 0,
+                        "worker threads (0 = caller-driven)")
+_params.register("sched", "lfq", "scheduler component to use")
+_params.register("termdet", "", "termination detector override")
+_params.register("runtime_nb_vp", 1, "number of virtual processes")
+
+
+class Context:
+    def __init__(self, nb_cores: int | None = None,
+                 scheduler: str | None = None,
+                 nb_ranks: int = 1, my_rank: int = 0) -> None:
+        from ..sched import ensure_registered as _sched_ensure
+        _sched_ensure()
+        from ..device import registry as device_registry
+        if nb_cores is None:
+            nb_cores = _params.get("runtime_num_cores")
+        self.nb_cores = nb_cores
+        self.nb_ranks = nb_ranks
+        self.my_rank = my_rank
+        self.started = False
+        self._shutdown = False
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._active_taskpools: list[Taskpool] = []
+        self.deps = DependencyTracking()
+        self.taskpool_list: list[Taskpool] = []
+        self.comm_engine: Any = None
+        self._worker_error: BaseException | None = None
+
+        # devices: registry is process-global; the context snapshots it
+        self.devices = device_registry
+
+        # virtual processes + streams (vpmap flat mode: one VP by default)
+        nb_vp = max(1, _params.get("runtime_nb_vp"))
+        nworkers = max(nb_cores, 0)
+        self.virtual_processes: list[VirtualProcess] = []
+        streams: list[ExecutionStream] = []
+        for v in range(nb_vp):
+            vp = VirtualProcess(v, self)
+            self.virtual_processes.append(vp)
+        for i in range(max(nworkers, 1)):
+            vp = self.virtual_processes[i % nb_vp]
+            es = ExecutionStream(i if nworkers else -1, vp, self)
+            vp.execution_streams.append(es)
+            streams.append(es)
+        self.streams = streams
+        # es used by external (non-worker) threads to submit/progress
+        self._submit_es = streams[0] if nworkers == 0 else \
+            ExecutionStream(-1, self.virtual_processes[0], self)
+
+        # scheduler via MCA (explicit arg > MCA param > priority query)
+        comp = repository.query("sched", context=self, requested=scheduler)
+        self.scheduler = comp.open(self)
+        self.scheduler.install(self)
+        for es in streams:
+            self.scheduler.flow_init(es)
+
+        # worker threads
+        self._threads: list[threading.Thread] = []
+        self._start_barrier = threading.Event()
+        if nworkers > 0:
+            for es in streams:
+                t = threading.Thread(target=self._worker_main, args=(es,),
+                                     name=f"parsec-es{es.th_id}", daemon=True)
+                self._threads.append(t)
+                t.start()
+
+    # ------------------------------------------------------------------ API
+    def add_taskpool(self, tp: Taskpool) -> None:
+        """``parsec_context_add_taskpool`` (``scheduling.c:850``)."""
+        tp.context = self
+        pins.fire(PinsEvent.TASKPOOL_INIT, None, tp)
+        if tp.tdm is None:
+            name = _params.get("termdet") or "local"
+            tp.tdm = repository.query("termdet", requested=name).open(self)
+        tp.tdm.monitor_taskpool(tp, tp.terminated)
+        with self._lock:
+            self._active_taskpools.append(tp)
+            self.taskpool_list.append(tp)
+        if tp.on_enqueue is not None:
+            tp.on_enqueue(tp)
+        n = tp.nb_local_tasks()
+        if n >= 0:
+            tp.tdm.taskpool_addto_nb_tasks(n)
+        startup = tp.startup(self)
+        tp.tdm.ready()
+        if startup:
+            schedule_tasks(self._submit_es, list(startup), 0)
+
+    def start(self) -> None:
+        """``parsec_context_start``: open the barrier, wake the comm thread."""
+        with self._lock:
+            self.started = True
+        if self.comm_engine is not None:
+            self.comm_engine.enable()
+        self._start_barrier.set()
+        with self._cond:
+            self._cond.notify_all()
+
+    def test(self) -> bool:
+        with self._lock:
+            return not self._active_taskpools
+
+    def wait(self, timeout: float | None = None) -> None:
+        """``parsec_context_wait``: block until every taskpool completes."""
+        if not self.started:
+            self.start()
+        self._drive_until(self.test, timeout)
+
+    def fini(self) -> None:
+        """``parsec_fini``: drain, stop workers, release the scheduler."""
+        if not self.test():
+            self.wait()
+        with self._lock:
+            self._shutdown = True
+            self._cond.notify_all()
+        self._start_barrier.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self.scheduler.remove(self)
+        if self.comm_engine is not None:
+            self.comm_engine.fini()
+
+    def __enter__(self) -> "Context":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if exc[0] is None:
+            self.fini()
+        else:
+            self.abort()
+
+    def abort(self) -> None:
+        """Stop workers without draining (exception-path teardown)."""
+        with self._lock:
+            self._shutdown = True
+            self._cond.notify_all()
+        self._start_barrier.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self.scheduler.remove(self)
+
+    # ------------------------------------------------------- progress loops
+    def _worker_main(self, es: ExecutionStream) -> None:
+        es.owner_ident = threading.get_ident()
+        self._start_barrier.wait()
+        backoff = Backoff()
+        while True:
+            if self._shutdown:
+                return
+            task, distance = select_task(es)
+            if task is None:
+                if self.comm_engine is not None and es.th_id == 0:
+                    self.comm_engine.progress(es)
+                backoff.wait()
+                continue
+            backoff.reset()
+            try:
+                task_progress(es, task, distance)
+            except BaseException as e:   # surface to waiters, don't hang
+                with self._lock:
+                    if self._worker_error is None:
+                        self._worker_error = e
+                    self._cond.notify_all()
+                return
+
+    def _drive_until(self, predicate: Callable[[], bool],
+                     timeout: float | None = None) -> None:
+        """Progress from the calling thread until ``predicate`` holds.
+
+        With workers, just wait on the condition; without, run the hot loop
+        inline (master-thread funneled mode)."""
+        if not self.started:
+            self.start()
+        if self._threads:
+            with self._cond:
+                ok = self._cond.wait_for(
+                    lambda: predicate() or self._worker_error is not None,
+                    timeout)
+                if self._worker_error is not None:
+                    raise RuntimeError(
+                        "a worker thread failed") from self._worker_error
+                if not ok:
+                    raise TimeoutError("context wait timed out")
+            return
+        es = self._submit_es
+        es.owner_ident = threading.get_ident()
+        backoff = Backoff()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not predicate():
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("context wait timed out")
+            task, distance = select_task(es)
+            if task is None:
+                if self.comm_engine is not None:
+                    self.comm_engine.progress(es)
+                if predicate():
+                    return
+                backoff.wait()
+                continue
+            backoff.reset()
+            task_progress(es, task, distance)
+
+    # ----------------------------------------------------------- internals
+    def _taskpool_terminated(self, tp: Taskpool) -> None:
+        with self._lock:
+            if tp in self._active_taskpools:
+                self._active_taskpools.remove(tp)
+            self._cond.notify_all()
+
+    # remote-dep seams; the comm layer replaces these (SURVEY §3.4)
+    def remote_dep_accumulate(self, remote, task, flow, dep, succ_tc,
+                              succ_locals, rank):
+        raise RuntimeError("remote successor but no comm engine installed")
+
+    def remote_dep_activate(self, es, task, remote) -> None:
+        raise RuntimeError("remote deps but no comm engine installed")

@@ -1,0 +1,77 @@
+"""Dependency tracking: hashed per-task IN-dep bookkeeping.
+
+Rebuild of the reference's dep-resolution core (``parsec.c:1293-1897``):
+not-yet-ready tasks are represented only by a *dependency tracker* in a hash
+table keyed by (task_class_id, task key) — the hashed variant
+(``parsec_hash_find_deps``, ``parsec.c:1501``); the multi-dimensional-array
+variant is an optimization the rebuild folds into the same interface.  Each
+arriving dep sets a bit in the satisfied mask (``parsec_update_deps_with_mask``
+``parsec.c:1577``); when it equals the required mask (computed by evaluating
+the class's input-dep guards for those locals), the task is instantiated with
+its input data attached and handed to the scheduler
+(``parsec_release_local_OUT_dependencies``, ``parsec.c:1670-1756``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from ..core.hash_table import ConcurrentHashTable
+from .task import Task, TaskClass
+
+
+class _DepTracker:
+    __slots__ = ("required_mask", "satisfied_mask", "inputs", "repo_refs",
+                 "priority")
+
+    def __init__(self, required_mask: int, nflows: int) -> None:
+        self.required_mask = required_mask
+        self.satisfied_mask = 0
+        self.inputs: list[Any] = [None] * nflows
+        self.repo_refs: list[Any] = [None] * nflows
+        self.priority = 0
+
+
+class DependencyTracking:
+    """One instance per taskpool (cf. per-task-class ``parsec_dependencies_t``)."""
+
+    def __init__(self) -> None:
+        self._table = ConcurrentHashTable()
+
+    def release_dep(self, taskpool: Any, tc: TaskClass, locals_: dict,
+                    flow_index: int, dep_index: int,
+                    data_copy: Any, repo_ref: Any = None) -> Task | None:
+        """Record one satisfied input dep; return the now-ready Task or None.
+
+        ``repo_ref`` is (repo_entry, src_flow_index) for usage accounting at
+        completion (``jdf2c.c:7157`` consume-input-repos contract).
+        """
+        key = (tc.task_class_id, tc.make_key(locals_))
+        bit = 1 << tc.dep_bit(flow_index, dep_index)
+        with self._table.locked(key):
+            trk = self._table.get(key)
+            if trk is None:
+                trk = _DepTracker(tc.input_dep_mask(locals_),
+                                  len(tc.flows))
+                self._table.insert(key, trk)
+            assert not (trk.satisfied_mask & bit), \
+                f"dep {tc.name}{key} bit {bit} satisfied twice"
+            trk.satisfied_mask |= bit
+            if data_copy is not None:
+                trk.inputs[flow_index] = data_copy
+                trk.repo_refs[flow_index] = repo_ref
+            ready = trk.satisfied_mask == trk.required_mask
+            if ready:
+                self._table.remove(key)
+        if not ready:
+            return None
+        prio = tc.priority(locals_) if tc.priority is not None else 0
+        task = Task(taskpool, tc, dict(locals_), priority=prio)
+        task.data = list(trk.inputs)
+        task.repo_entries = list(trk.repo_refs)
+        task.status = "ready"
+        return task
+
+    def __len__(self) -> int:
+        return len(self._table)

@@ -1,0 +1,286 @@
+"""The scheduling loop: execute / complete / release-deps.
+
+Rebuild of ``parsec/scheduling.c`` (SURVEY §3.3): per-worker select →
+``prepare_input`` → chore execution (``__parsec_execute``) → completion →
+``release_deps`` walking successor edges, instantiating newly-ready tasks into
+the scheduler, with the highest-priority released task kept as the stream's
+``next_task`` for cache reuse (``scheduling.c:562-575``).
+
+Device chores return ``HOOK_RETURN_ASYNC`` and complete through
+:func:`complete_execution` from the device manager, exactly like the GPU
+path (§3.5).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from ..core.params import params as _params
+from ..prof import pins
+from ..prof.pins import PinsEvent
+from .task import (HOOK_RETURN_AGAIN, HOOK_RETURN_ASYNC, HOOK_RETURN_DISABLE,
+                   HOOK_RETURN_DONE, HOOK_RETURN_ERROR, HOOK_RETURN_NEXT,
+                   Task, TaskClass)
+
+_params.register(
+    "runtime_keep_highest_priority_task", True,
+    "hold the best released task as the stream's next task "
+    "(parsec_runtime_keep_highest_priority_task)")
+
+
+class ExecutionStream:
+    """One worker's execution context (cf. ``parsec_execution_stream_t``)."""
+
+    __slots__ = ("th_id", "virtual_process", "context", "next_task",
+                 "sched_private", "rand_state", "profiling", "owner_ident")
+
+    def __init__(self, th_id: int, virtual_process: Any, context: Any) -> None:
+        self.th_id = th_id
+        self.virtual_process = virtual_process
+        self.context = context
+        self.next_task: Task | None = None
+        self.sched_private: Any = None
+        self.rand_state = (th_id * 2654435761) & 0xFFFFFFFF
+        self.profiling: Any = None
+        self.owner_ident: int = -1   # thread id that owns next_task
+
+
+class VirtualProcess:
+    """A no-work-stealing-across partition of streams (cf. ``vpmap.c``)."""
+
+    __slots__ = ("vp_id", "context", "execution_streams", "sched_private")
+
+    def __init__(self, vp_id: int, context: Any) -> None:
+        self.vp_id = vp_id
+        self.context = context
+        self.execution_streams: list[ExecutionStream] = []
+        self.sched_private: Any = None
+
+
+# ---------------------------------------------------------------------------
+# schedule / select
+# ---------------------------------------------------------------------------
+
+def schedule_tasks(es: ExecutionStream, tasks: list[Task],
+                   distance: int = 0) -> None:
+    """``__parsec_schedule``: hand ready tasks to the scheduler module."""
+    if not tasks:
+        return
+    pins.fire(PinsEvent.SCHEDULE_BEGIN, es, tasks)
+    keep = _params.get("runtime_keep_highest_priority_task")
+    # next_task is a single-owner slot: only the thread running this stream's
+    # hot loop may touch it (a device manager or comm thread completing a
+    # task on behalf of another stream must go through the scheduler)
+    if keep and es.owner_ident == threading.get_ident() \
+            and es.next_task is None and es.context.started:
+        tasks.sort(key=lambda t: t.priority)
+        es.next_task = tasks.pop()  # highest priority stays hot
+    if tasks:
+        es.context.scheduler.schedule(es, tasks, distance)
+    pins.fire(PinsEvent.SCHEDULE_END, es, tasks)
+
+
+def select_task(es: ExecutionStream) -> tuple[Task | None, int]:
+    if es.next_task is not None:
+        t, es.next_task = es.next_task, None
+        return t, 0
+    pins.fire(PinsEvent.SELECT_BEGIN, es)
+    t, distance = es.context.scheduler.select(es)
+    pins.fire(PinsEvent.SELECT_END, es, t)
+    return t, distance
+
+
+# ---------------------------------------------------------------------------
+# execute
+# ---------------------------------------------------------------------------
+
+def execute_task(es: ExecutionStream, task: Task) -> int:
+    """``__parsec_execute``: walk the class's chores honoring the task's
+    chore mask and the evaluate/hook return protocol."""
+    tc = task.task_class
+    pins.fire(PinsEvent.EXEC_BEGIN, es, task)
+    try:
+        for i, chore in enumerate(tc.chores):
+            if not (task.chore_mask & (1 << i)) or not chore.enabled:
+                continue
+            if chore.evaluate is not None:
+                if chore.evaluate(es, task) == HOOK_RETURN_NEXT:
+                    continue
+            rc = chore.hook(es, task)
+            if rc == HOOK_RETURN_NEXT:
+                task.chore_mask &= ~(1 << i)
+                continue
+            if rc == HOOK_RETURN_DISABLE:
+                chore.enabled = False
+                task.chore_mask &= ~(1 << i)
+                continue
+            return rc
+        return HOOK_RETURN_ERROR
+    finally:
+        pins.fire(PinsEvent.EXEC_END, es, task)
+
+
+def task_progress(es: ExecutionStream, task: Task, distance: int) -> int:
+    """``__parsec_task_progress``: one task through its lifecycle."""
+    pins.fire(PinsEvent.PREPARE_INPUT_BEGIN, es, task)
+    prepare_input(es, task)
+    pins.fire(PinsEvent.PREPARE_INPUT_END, es, task)
+    rc = execute_task(es, task)
+    if rc == HOOK_RETURN_DONE:
+        complete_execution(es, task)
+    elif rc == HOOK_RETURN_ASYNC:
+        pass  # a device manager owns completion now
+    elif rc == HOOK_RETURN_AGAIN:
+        task.status = "rescheduled"
+        schedule_tasks(es, [task], distance + 1)
+    else:
+        raise RuntimeError(f"task {task} failed: no runnable chore (rc={rc})")
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# data resolution
+# ---------------------------------------------------------------------------
+
+def prepare_input(es: ExecutionStream, task: Task) -> None:
+    """Generic data lookup (cf. generated ``data_lookup``, ``jdf2c.c:44``):
+    flows fed by predecessors already carry their copies (attached at dep
+    release); remaining flows resolve against the data collection or
+    allocate scratch."""
+    tc = task.task_class
+    if tc.prepare_input is not None:
+        tc.prepare_input(es, task)
+        return
+    for f in tc.flows:
+        if f.is_ctl or task.data[f.flow_index] is not None:
+            continue
+        for d in f.deps_in:
+            if d.target_class is None and d.active(task.locals):
+                if d.data_ref is None:
+                    break
+                dc, key = d.data_ref(task.locals)
+                datum = dc.data_of(*key)
+                copy = datum.newest_copy()
+                if copy is None:
+                    raise RuntimeError(
+                        f"{task}: flow {f.name} has no valid copy")
+                task.data[f.flow_index] = copy
+                break
+        if task.data[f.flow_index] is None and f.dtt is not None:
+            # WRITE-only flow: allocate scratch of the declared tile type
+            import numpy as np
+
+            from ..data.data import data_create
+            scratch = data_create(np.zeros(f.dtt.shape, dtype=f.dtt.dtype),
+                                  dtt=f.dtt)
+            task.data[f.flow_index] = scratch.get_copy(0)
+
+
+def _find_input_dep(succ_tc: TaskClass, flow_name: str, src_class: str,
+                    succ_locals: dict) -> tuple[int, int]:
+    for f in succ_tc.flows:
+        if f.name != flow_name:
+            continue
+        for di, d in enumerate(f.deps_in):
+            if d.target_class == src_class and d.active(succ_locals):
+                return f.flow_index, di
+        raise LookupError(
+            f"{succ_tc.name}.{flow_name}: no active input dep from {src_class}")
+    raise KeyError(f"{succ_tc.name} has no flow {flow_name}")
+
+
+# ---------------------------------------------------------------------------
+# completion / release
+# ---------------------------------------------------------------------------
+
+def complete_execution(es: ExecutionStream, task: Task) -> None:
+    """``__parsec_complete_execution``: outputs → repo/collection, successor
+    release, input-repo consumption, task retirement."""
+    pins.fire(PinsEvent.COMPLETE_EXEC_BEGIN, es, task)
+    tc = task.task_class
+    tp = task.taskpool
+    if tc.complete_execution is not None:
+        tc.complete_execution(es, task)
+    release_deps(es, task)
+    # consume the input repo entries (GC protocol, jdf2c.c:7157)
+    for ref in task.repo_entries:
+        if ref is not None:
+            entry, src_flow = ref
+            entry.consume(src_flow)
+    task.status = "done"
+    if task.on_complete is not None:
+        task.on_complete(task)
+    pins.fire(PinsEvent.COMPLETE_EXEC_END, es, task)
+    tp.tdm.taskpool_addto_nb_tasks(-1)
+
+
+def release_deps(es: ExecutionStream, task: Task) -> None:
+    """Generic ``release_deps`` (cf. generated code, ``jdf2c.c:7185``, and the
+    per-edge visitor ``parsec_release_dep_fct``, ``parsec.c:1759``): walk
+    active out-deps; write-back edges update the collection; successor edges
+    update dep trackers, collecting now-ready tasks; remote successors
+    accumulate into a remote-deps set activated through the comm engine."""
+    pins.fire(PinsEvent.RELEASE_DEPS_BEGIN, es, task)
+    tc = task.task_class
+    tp = task.taskpool
+    ctx = tp.context
+    entry = None
+    nconsumers = 0
+    ready: list[Task] = []
+    remote = None
+
+    def visitor(t: Task, flow, dep) -> None:
+        nonlocal entry, nconsumers, remote
+        out_copy = None if flow.is_ctl else t.data[flow.flow_index]
+        if dep.target_class is None:
+            _writeback(t, flow, dep, out_copy)
+            return
+        succ_tc = tp.task_class(dep.target_class)
+        succ_locals = dep.target_params(t.locals)
+        rank = _rank_of_task(ctx, succ_tc, succ_locals)
+        if rank is not None and rank != ctx.my_rank:
+            remote = ctx.remote_dep_accumulate(remote, t, flow, dep,
+                                               succ_tc, succ_locals, rank)
+            return
+        fi, di = _find_input_dep(succ_tc, dep.target_flow, tc.name,
+                                 succ_locals)
+        repo_ref = None
+        if out_copy is not None:
+            if entry is None:
+                entry = tc.repo.lookup_and_create(t.key)
+            entry.set_output(flow.flow_index, out_copy)
+            repo_ref = (entry, flow.flow_index)
+            nconsumers += 1
+        ready_task = ctx.deps.release_dep(tp, succ_tc, succ_locals, fi, di,
+                                          out_copy, repo_ref)
+        if ready_task is not None:
+            ready.append(ready_task)
+
+    tc.iterate_successors(task, visitor)
+    if entry is not None:
+        entry.addto_usage_limit(nconsumers)
+    if remote is not None:
+        ctx.remote_dep_activate(es, task, remote)
+    pins.fire(PinsEvent.RELEASE_DEPS_END, es, task)
+    if ready:
+        schedule_tasks(es, ready, 0)
+
+
+def _writeback(task: Task, flow, dep, out_copy) -> None:
+    if out_copy is None or dep.data_ref is None:
+        return
+    dc, key = dep.data_ref(task.locals)
+    datum = dc.data_of(*key)
+    home = datum.get_copy(0)
+    if home is None or home is out_copy:
+        return
+    home.value = out_copy.value
+    home.version = max(home.version, out_copy.version) + 1
+
+
+def _rank_of_task(ctx, tc: TaskClass, locals_: dict):
+    if ctx.nb_ranks <= 1 or tc.affinity is None:
+        return None
+    dc, key = tc.affinity(locals_)
+    return dc.rank_of(*key)

@@ -1,0 +1,217 @@
+"""Task classes, flows, dependencies, task instances.
+
+Rebuild of the reference's task model (``parsec_internal.h``): a *task class*
+(``parsec_task_class_t``, :409-457) describes one kind of micro-task — its
+parameters ("locals"), dataflow (flows with guarded in/out deps), data
+affinity, priority, and a list of *incarnations* ("chores") binding bodies to
+device types; a *task* (:539-551) is one instance with concrete locals.
+
+TPU-first notes: a chore's body is a host callable for CPU incarnations and a
+kernel-registry name (compiled XLA/Pallas executable) for TPU incarnations;
+``time_estimate`` feeds best-device selection exactly as in the reference
+(``parsec_internal.h:441``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Sequence
+
+from ..data.data import ACCESS_NONE, ACCESS_READ, ACCESS_RW, ACCESS_WRITE
+
+# Hook return protocol (cf. runtime.h:139-147).
+HOOK_RETURN_DONE = 0        # body executed to completion
+HOOK_RETURN_ASYNC = -1      # body progresses asynchronously (device owns it)
+HOOK_RETURN_AGAIN = -2      # reschedule the same chore later
+HOOK_RETURN_NEXT = -3       # try the next chore / device
+HOOK_RETURN_DISABLE = -4    # disable this chore for every task of the class
+HOOK_RETURN_ERROR = -5
+
+# Flow kinds: data access modes come from parsec_tpu.data; CTL is pure control.
+FLOW_CTL = "CTL"
+
+# Device type tags for chores (cf. PARSEC_DEV_* masks).
+DEV_CPU = "cpu"
+DEV_TPU = "tpu"
+DEV_RECURSIVE = "recursive"
+
+_task_counter = itertools.count()
+
+
+class Dep:
+    """One dependency edge endpoint on a flow (cf. ``parsec_dep_t``).
+
+    For an *output* dep: when ``guard(locals)`` holds, the flow's datum feeds
+    task ``target_class`` instance ``target_params(locals)`` on flow
+    ``target_flow``; ``target_class is None`` means the edge writes back to
+    the data collection (``A(k)`` arrow target).  For an *input* dep the
+    fields describe the predecessor symmetrically; ``target_class is None``
+    means the flow reads directly from the collection.
+    """
+
+    __slots__ = ("guard", "target_class", "target_flow", "target_params",
+                 "dtt", "data_ref")
+
+    def __init__(self, guard: Callable[[dict], bool] | None = None,
+                 target_class: str | None = None,
+                 target_flow: str | None = None,
+                 target_params: Callable[[dict], tuple] | None = None,
+                 dtt: Any = None,
+                 data_ref: Callable[[dict], tuple] | None = None) -> None:
+        self.guard = guard
+        self.target_class = target_class
+        self.target_flow = target_flow
+        self.target_params = target_params
+        self.dtt = dtt
+        self.data_ref = data_ref  # (collection, key...) accessor for dc edges
+
+    def active(self, locals_: dict) -> bool:
+        return self.guard is None or bool(self.guard(locals_))
+
+
+class Flow:
+    """A named dataflow of a task class (cf. ``parsec_flow_t``)."""
+
+    __slots__ = ("name", "access", "flow_index", "deps_in", "deps_out", "dtt")
+
+    def __init__(self, name: str, access: Any, flow_index: int = -1,
+                 deps_in: Sequence[Dep] = (), deps_out: Sequence[Dep] = (),
+                 dtt: Any = None) -> None:
+        self.name = name
+        self.access = access            # ACCESS_* or FLOW_CTL
+        self.flow_index = flow_index
+        self.deps_in = list(deps_in)
+        self.deps_out = list(deps_out)
+        self.dtt = dtt                  # TileType for scratch allocation
+
+    @property
+    def is_ctl(self) -> bool:
+        return self.access == FLOW_CTL
+
+
+class Chore:
+    """One incarnation of a task class on a device type (cf. ``__parsec_chore_t``)."""
+
+    __slots__ = ("device_type", "hook", "evaluate", "dyld", "enabled")
+
+    def __init__(self, device_type: str, hook: Callable | None = None,
+                 evaluate: Callable | None = None, dyld: str | None = None) -> None:
+        self.device_type = device_type
+        self.hook = hook          # (es, task) -> HOOK_RETURN_*
+        self.evaluate = evaluate  # (es, task) -> DONE (use) / NEXT (skip)
+        self.dyld = dyld          # kernel-registry name for device bodies
+        self.enabled = True
+
+
+class TaskClass:
+    """Static description of one task kind (cf. ``parsec_task_class_t``)."""
+
+    def __init__(self, name: str, params: Sequence[str],
+                 flows: Sequence[Flow], chores: Sequence[Chore],
+                 task_class_id: int = -1,
+                 affinity: Callable[[dict], tuple] | None = None,
+                 priority: Callable[[dict], int] | None = None,
+                 time_estimate: Callable[[Any, Any], float] | None = None,
+                 prepare_input: Callable | None = None,
+                 complete_execution: Callable | None = None) -> None:
+        self.name = name
+        self.params = list(params)
+        self.flows = list(flows)
+        for i, f in enumerate(self.flows):
+            f.flow_index = i
+        self.chores = list(chores)
+        self.task_class_id = task_class_id
+        self.affinity = affinity          # locals -> (collection, key) rank home
+        self.priority = priority
+        self.time_estimate = time_estimate
+        self.prepare_input = prepare_input
+        self.complete_execution = complete_execution
+        self.repo = None                  # DataRepo, attached by the taskpool
+        self.dependencies_goal = 0        # unused for guarded classes
+
+    # -- keys ---------------------------------------------------------------
+    def make_key(self, locals_: dict) -> tuple:
+        """Canonical task key (cf. generated ``make_key`` fns)."""
+        return tuple(locals_[p] for p in self.params)
+
+    # -- dep structure ------------------------------------------------------
+    def input_dep_mask(self, locals_: dict) -> int:
+        """Bitmask of (flow_index, dep_index) input deps active for these
+        locals — the per-task IN-dep mask (cf. ``parsec.c:1293``)."""
+        mask = 0
+        bit = 0
+        for f in self.flows:
+            for d in f.deps_in:
+                if d.target_class is not None and d.active(locals_):
+                    mask |= 1 << bit
+                bit += 1
+        return mask
+
+    def dep_bit(self, flow_index: int, dep_index: int) -> int:
+        bit = 0
+        for fi, f in enumerate(self.flows):
+            for di, _ in enumerate(f.deps_in):
+                if fi == flow_index and di == dep_index:
+                    return bit
+                bit += 1
+        raise IndexError((flow_index, dep_index))
+
+    def iterate_successors(self, task: "Task", visitor: Callable) -> None:
+        """Visit every *active* out-dep edge of ``task``.
+
+        ``visitor(task, flow, dep)`` — the analog of the generated
+        ``iterate_successors`` walking guarded arrow targets inline
+        (SURVEY §3.3).
+        """
+        for f in self.flows:
+            for d in f.deps_out:
+                if d.active(task.locals):
+                    visitor(task, f, d)
+
+    def __repr__(self) -> str:
+        return f"<TaskClass {self.name}({', '.join(self.params)})>"
+
+
+class Task:
+    """One executable instance of a task class (cf. ``parsec_task_t``)."""
+
+    __slots__ = ("taskpool", "task_class", "locals", "priority", "data",
+                 "repo_entries", "status", "chore_mask", "uid",
+                 "selected_device", "_mempool_owner", "on_complete")
+
+    def __init__(self, taskpool: Any, task_class: TaskClass,
+                 locals_: dict, priority: int = 0) -> None:
+        self.taskpool = taskpool
+        self.task_class = task_class
+        self.locals = locals_
+        self.priority = priority
+        # per-flow resolved input copies; outputs written here too
+        self.data: list[Any] = [None] * len(task_class.flows)
+        # per-flow (repo_entry, src_flow_index) to consume after execution
+        self.repo_entries: list[Any] = [None] * len(task_class.flows)
+        self.status = "nascent"
+        self.chore_mask = (1 << len(task_class.chores)) - 1
+        self.uid = next(_task_counter)
+        self.selected_device = None
+        self.on_complete = None
+
+    @property
+    def key(self) -> tuple:
+        return self.task_class.make_key(self.locals)
+
+    def flow_data(self, name: str) -> Any:
+        for f in self.task_class.flows:
+            if f.name == name:
+                return self.data[f.flow_index]
+        raise KeyError(name)
+
+    def set_flow_data(self, name: str, value: Any) -> None:
+        for f in self.task_class.flows:
+            if f.name == name:
+                self.data[f.flow_index] = value
+                return
+        raise KeyError(name)
+
+    def __repr__(self) -> str:
+        args = ", ".join(f"{p}={self.locals[p]}" for p in self.task_class.params)
+        return f"<Task {self.task_class.name}({args})>"

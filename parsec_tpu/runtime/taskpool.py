@@ -1,0 +1,125 @@
+"""Taskpools: DAG containers with lifecycle + registry.
+
+Rebuild of ``parsec_taskpool_t`` (``parsec_internal.h:120-166``) and the
+global taskpool registry (``parsec.c:2038-2152``): a taskpool owns task
+classes, their data repos, a termination-detection monitor (the *only* path to
+``nb_tasks`` / ``nb_pending_actions``), startup enumeration, and completion
+callbacks.  :func:`compose` provides sequential composition
+(``compound.c``, ``parsec_compose`` ``runtime.h:588-596``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Sequence
+
+from ..core.hash_table import ConcurrentHashTable
+from ..data.datarepo import DataRepo
+from .task import Task, TaskClass
+from .termdet import TermDetMonitor
+
+_taskpool_ids = itertools.count(1)
+_registry = ConcurrentHashTable()
+
+
+def taskpool_lookup(tp_id: int) -> "Taskpool | None":
+    return _registry.get(tp_id)
+
+
+class Taskpool:
+    def __init__(self, name: str = "",
+                 task_classes: Sequence[TaskClass] = ()) -> None:
+        self.name = name or f"taskpool{next(_taskpool_ids)}"
+        self.taskpool_id = next(_taskpool_ids)
+        self.context: Any = None
+        self.tdm: TermDetMonitor | None = None
+        self.task_classes: list[TaskClass] = []
+        self.task_classes_by_name: dict[str, TaskClass] = {}
+        for tc in task_classes:
+            self.add_task_class(tc)
+        self.on_enqueue: Callable[["Taskpool"], None] | None = None
+        self.on_complete: Callable[["Taskpool"], None] | None = None
+        self._done = threading.Event()
+        self.priority = 0
+        _registry.insert(self.taskpool_id, self)
+
+    # -- structure ----------------------------------------------------------
+    def add_task_class(self, tc: TaskClass) -> TaskClass:
+        tc.task_class_id = len(self.task_classes)
+        self.task_classes.append(tc)
+        self.task_classes_by_name[tc.name] = tc
+        tc.repo = DataRepo(len(tc.flows), name=f"{self.name}.{tc.name}")
+        return tc
+
+    def task_class(self, name: str) -> TaskClass:
+        return self.task_classes_by_name[name]
+
+    # -- lifecycle ----------------------------------------------------------
+    def startup(self, context: Any) -> list[Task]:
+        """Enumerate initially-ready tasks (cf. generated ``_startup`` hooks,
+        ``jdf2c.c:3035``).  Subclasses/DSLs override."""
+        return []
+
+    def nb_local_tasks(self) -> int:
+        """Total local task count, set into the termdet at enqueue time.
+        Subclasses computing it exactly override (cf. generated
+        ``nb_local_tasks_fn``); -1 means unknown (dynamic/DTD)."""
+        return -1
+
+    def terminated(self) -> None:
+        self._done.set()
+        if self.on_complete is not None:
+            self.on_complete(self)
+        if self.context is not None:
+            self.context._taskpool_terminated(self)
+
+    def wait(self, timeout: float | None = None) -> None:
+        """``parsec_taskpool_wait`` — block until this taskpool completes.
+
+        The calling thread *drives progress* while waiting when it is not a
+        worker (single-threaded contexts), mirroring the master-thread
+        progress path (``scheduling.c:775-784``)."""
+        if self.context is not None:
+            self.context._drive_until(lambda: self._done.is_set(), timeout)
+        elif not self._done.wait(timeout):
+            raise TimeoutError(f"taskpool {self.name} did not complete")
+
+    def test(self) -> bool:
+        """``parsec_taskpool_test`` — non-blocking completion check."""
+        return self._done.is_set()
+
+
+class CompoundTaskpool(Taskpool):
+    """Sequential composition: each member starts when its predecessor
+    terminates (``compound.c:135``)."""
+
+    def __init__(self, members: Sequence[Taskpool]) -> None:
+        super().__init__(name="compound")
+        self.members = list(members)
+        self._idx = 0
+
+    def startup(self, context: Any) -> list[Task]:
+        self.tdm.taskpool_addto_nb_pa(+1)  # alive until the last member ends
+        self._start_next(context)
+        return []
+
+    def _start_next(self, context: Any) -> None:
+        if self._idx >= len(self.members):
+            self.tdm.taskpool_addto_nb_pa(-1)
+            return
+        member = self.members[self._idx]
+        self._idx += 1
+        prev_cb = member.on_complete
+
+        def chain(tp: Taskpool) -> None:
+            if prev_cb is not None:
+                prev_cb(tp)
+            self._start_next(context)
+
+        member.on_complete = chain
+        context.add_taskpool(member)
+
+
+def compose(*taskpools: Taskpool) -> CompoundTaskpool:
+    return CompoundTaskpool(taskpools)

@@ -1,0 +1,131 @@
+"""Termination detection.
+
+Rebuild of ``parsec/mca/termdet/`` (SURVEY §2.4): a taskpool holds a monitor
+through which *all* updates to ``nb_tasks`` / ``nb_pending_actions`` must flow
+(``parsec_internal.h:124-144``); the detector walks the state machine
+NOT_READY → BUSY → IDLE → TERMINATED (``termdet.h:36-67``) and fires the
+taskpool's termination callback exactly once.
+
+This module provides the **local** detector (counter reaches zero,
+``termdet/local/``) and the **user-trigger** detector (application decides,
+``termdet/user_trigger/``).  The distributed **fourcounter** wave algorithm
+lives with the comm engine (it needs an AM tag).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+from ..core.mca import Component, component
+
+STATE_NOT_READY = 0
+STATE_BUSY = 1
+STATE_IDLE = 2
+STATE_TERMINATED = 3
+
+
+class TermDetMonitor:
+    """Base monitor attached to a taskpool (cf. ``parsec_termdet_module_t``)."""
+
+    name = "base"
+
+    def __init__(self) -> None:
+        self.state = STATE_NOT_READY
+        self._lock = threading.Lock()
+        self._on_terminated: Callable[[], None] | None = None
+        self.nb_tasks = 0
+        self.nb_pending_actions = 0
+
+    def monitor_taskpool(self, taskpool: Any,
+                         on_terminated: Callable[[], None]) -> None:
+        self._on_terminated = on_terminated
+        self.taskpool = taskpool
+
+    def ready(self) -> None:
+        """All initial tasks/actions registered; detection may now conclude."""
+        fire = False
+        with self._lock:
+            if self.state == STATE_NOT_READY:
+                self.state = STATE_BUSY
+                fire = self._check_idle_locked()
+        if fire:
+            self._terminate()
+
+    # -- the only legal mutators of the counters ----------------------------
+    def taskpool_addto_nb_tasks(self, delta: int) -> int:
+        fire = False
+        with self._lock:
+            self.nb_tasks += delta
+            assert self.nb_tasks >= 0, "nb_tasks went negative"
+            fire = self._check_idle_locked()
+        if fire:
+            self._terminate()
+        return self.nb_tasks
+
+    def taskpool_addto_nb_pa(self, delta: int) -> int:
+        fire = False
+        with self._lock:
+            self.nb_pending_actions += delta
+            assert self.nb_pending_actions >= 0, "nb_pending_actions went negative"
+            fire = self._check_idle_locked()
+        if fire:
+            self._terminate()
+        return self.nb_pending_actions
+
+    def _check_idle_locked(self) -> bool:
+        if (self.state == STATE_BUSY and self.nb_tasks == 0
+                and self.nb_pending_actions == 0):
+            self.state = STATE_TERMINATED
+            return True
+        return False
+
+    def _terminate(self) -> None:
+        if self._on_terminated is not None:
+            self._on_terminated()
+
+
+class LocalTermDet(TermDetMonitor):
+    """Single-process counter detector (``termdet/local``)."""
+
+    name = "local"
+
+
+class UserTriggerTermDet(TermDetMonitor):
+    """Application-driven termination (``termdet/user_trigger``): counters are
+    tracked but only :meth:`trigger` terminates the taskpool."""
+
+    name = "user_trigger"
+
+    def _check_idle_locked(self) -> bool:
+        return False
+
+    def trigger(self) -> None:
+        with self._lock:
+            already = self.state == STATE_TERMINATED
+            self.state = STATE_TERMINATED
+        if not already:
+            self._terminate()
+
+
+@component
+class LocalTermDetComponent(Component):
+    type_name = "termdet"
+    name = "local"
+    priority = 20
+
+    def open(self, context: Any = None) -> TermDetMonitor:
+        return LocalTermDet()
+
+
+@component
+class UserTriggerTermDetComponent(Component):
+    type_name = "termdet"
+    name = "user_trigger"
+    priority = 1
+
+    def query(self, context: Any = None) -> bool:
+        return False  # only by explicit request
+
+    def open(self, context: Any = None) -> TermDetMonitor:
+        return UserTriggerTermDet()

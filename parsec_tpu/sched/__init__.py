@@ -1,0 +1,16 @@
+"""Pluggable schedulers (rebuild of ``parsec/mca/sched/``)."""
+
+from .api import SchedulerModule
+
+_registered = False
+
+
+def ensure_registered() -> None:
+    """Import-time component registration, idempotent."""
+    global _registered
+    if not _registered:
+        from . import modules  # noqa: F401
+        _registered = True
+
+
+__all__ = ["SchedulerModule", "ensure_registered"]

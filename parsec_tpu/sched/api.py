@@ -1,0 +1,38 @@
+"""Scheduler component interface.
+
+Rebuild of ``parsec/mca/sched/sched.h:183-353``: a scheduler module exposes
+``install / flow_init / schedule / select / remove``.  The *distance* contract
+(``sched.h:22-170``) is preserved: ``schedule(es, tasks, distance)`` hints how
+far from the submitting stream the tasks should land (0 = hot, larger = was
+rescheduled / overflowed), and ``select`` returns the distance the task came
+from so starvation pushes work outward fairly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+
+class SchedulerModule:
+    name = "base"
+
+    def install(self, context: Any) -> None:
+        """Global structures; called once per context."""
+
+    def flow_init(self, es: Any) -> None:
+        """Per-execution-stream structures; called from each worker before
+        the barrier opens (cf. ``flow_init`` rendezvous)."""
+
+    def schedule(self, es: Any, tasks: Sequence[Any], distance: int = 0) -> None:
+        raise NotImplementedError
+
+    def select(self, es: Any) -> tuple[Any | None, int]:
+        """Return (task, distance) or (None, 0)."""
+        raise NotImplementedError
+
+    def remove(self, context: Any) -> None:
+        """Tear down; must leave no queued tasks behind."""
+
+    def pending_tasks(self, context: Any) -> int:
+        """Approximate queue depth (PAPI-SDE counter analog)."""
+        return -1

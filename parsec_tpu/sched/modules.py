@@ -1,0 +1,298 @@
+"""Scheduler implementations.
+
+Rebuild of the reference's scheduler zoo (``parsec/mca/sched/*``, SURVEY
+§2.4): **lfq** (default) per-stream bounded buffers spilling to a per-VP
+overflow dequeue, with sibling stealing; **ap** global absolute-priority
+list; **spq** global priority+distance list (the tutorial scheduler,
+``sched.h:87-169``); **gd** global dequeue; **ll/llp** per-stream LIFOs with
+stealing (± priority); **rnd** random; **ip** inverse priority.  Priorities
+and the distance contract follow ``sched/api.py``.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+import threading
+from collections import deque
+from typing import Any, Sequence
+
+from ..core.params import params as _params
+from ..core.hbbuffer import HBBuffer
+from ..core.mca import Component, component
+from .api import SchedulerModule
+
+_params.register("sched_lfq_buffer_size", 8,
+                        "per-stream bounded-buffer capacity for lfq")
+
+
+# ---------------------------------------------------------------------------
+# lfq — local flat queues (default; cf. sched/lfq, priority 20)
+# ---------------------------------------------------------------------------
+
+class _VPQueues:
+    def __init__(self) -> None:
+        self.system = deque()
+        self.lock = threading.Lock()
+
+
+class LFQModule(SchedulerModule):
+    name = "lfq"
+
+    def install(self, context: Any) -> None:
+        for vp in context.virtual_processes:
+            vp.sched_private = _VPQueues()
+        self._cap = _params.get("sched_lfq_buffer_size")
+
+    def flow_init(self, es: Any) -> None:
+        vpq = es.virtual_process.sched_private
+
+        def overflow(items: list, distance: int) -> None:
+            with vpq.lock:
+                vpq.system.extend(items)
+
+        es.sched_private = HBBuffer(self._cap, parent_push=overflow)
+
+    def schedule(self, es: Any, tasks: Sequence[Any], distance: int = 0) -> None:
+        if es.sched_private is None or distance > 0:
+            vpq = es.virtual_process.sched_private
+            with vpq.lock:
+                vpq.system.extend(tasks)
+            return
+        es.sched_private.push_all(list(tasks), distance)
+
+    def select(self, es: Any) -> tuple[Any | None, int]:
+        if es.sched_private is not None:
+            t = es.sched_private.try_pop_best(priority=lambda x: x.priority)
+            if t is not None:
+                return t, 0
+        # steal from sibling streams in the same VP (never across VPs)
+        for sib in es.virtual_process.execution_streams:
+            if sib is es or sib.sched_private is None:
+                continue
+            t = sib.sched_private.steal()
+            if t is not None:
+                return t, 1
+        vpq = es.virtual_process.sched_private
+        with vpq.lock:
+            if vpq.system:
+                return vpq.system.popleft(), 2
+        return None, 0
+
+    def remove(self, context: Any) -> None:
+        for vp in context.virtual_processes:
+            vp.sched_private = None
+            for es in vp.execution_streams:
+                es.sched_private = None
+
+    def pending_tasks(self, context: Any) -> int:
+        n = 0
+        for vp in context.virtual_processes:
+            if vp.sched_private is not None:
+                n += len(vp.sched_private.system)
+            for es in vp.execution_streams:
+                if es.sched_private is not None:
+                    n += len(es.sched_private)
+        return n
+
+
+# ---------------------------------------------------------------------------
+# global single-queue family
+# ---------------------------------------------------------------------------
+
+class _GlobalHeapModule(SchedulerModule):
+    """Shared helper: one process-global heap ordered by a key fn."""
+
+    def install(self, context: Any) -> None:
+        self._heap: list = []
+        self._lock = threading.Lock()
+        self._tie = itertools.count()
+
+    def _key(self, task: Any, distance: int):
+        raise NotImplementedError
+
+    def schedule(self, es: Any, tasks: Sequence[Any], distance: int = 0) -> None:
+        with self._lock:
+            for t in tasks:
+                heapq.heappush(self._heap,
+                               (self._key(t, distance), next(self._tie), t))
+
+    def select(self, es: Any) -> tuple[Any | None, int]:
+        with self._lock:
+            if not self._heap:
+                return None, 0
+            _, _, t = heapq.heappop(self._heap)
+            return t, 0
+
+    def remove(self, context: Any) -> None:
+        self._heap = []
+
+    def pending_tasks(self, context: Any) -> int:
+        return len(self._heap)
+
+
+class APModule(_GlobalHeapModule):
+    """Absolute priority: highest priority first (cf. sched/ap)."""
+    name = "ap"
+
+    def _key(self, task: Any, distance: int):
+        return (-task.priority,)
+
+
+class SPQModule(_GlobalHeapModule):
+    """Priority then distance (the documented tutorial scheduler, sched/spq)."""
+    name = "spq"
+
+    def _key(self, task: Any, distance: int):
+        return (-task.priority, distance)
+
+
+class IPModule(_GlobalHeapModule):
+    """Inverse priority — lowest first (cf. sched/ip; a testing policy)."""
+    name = "ip"
+
+    def _key(self, task: Any, distance: int):
+        return (task.priority,)
+
+
+class GDModule(SchedulerModule):
+    """Global dequeue (cf. sched/gd): hot tasks to the front."""
+    name = "gd"
+
+    def install(self, context: Any) -> None:
+        self._dq = deque()
+        self._lock = threading.Lock()
+
+    def schedule(self, es: Any, tasks: Sequence[Any], distance: int = 0) -> None:
+        with self._lock:
+            if distance == 0:
+                self._dq.extendleft(reversed(list(tasks)))
+            else:
+                self._dq.extend(tasks)
+
+    def select(self, es: Any) -> tuple[Any | None, int]:
+        with self._lock:
+            if self._dq:
+                return self._dq.popleft(), 0
+        return None, 0
+
+    def remove(self, context: Any) -> None:
+        self._dq = deque()
+
+    def pending_tasks(self, context: Any) -> int:
+        return len(self._dq)
+
+
+class RNDModule(SchedulerModule):
+    """Random selection (cf. sched/rnd; a fairness fuzzer)."""
+    name = "rnd"
+
+    def install(self, context: Any) -> None:
+        self._items: list = []
+        self._lock = threading.Lock()
+        self._rng = random.Random(0x9a53)
+
+    def schedule(self, es: Any, tasks: Sequence[Any], distance: int = 0) -> None:
+        with self._lock:
+            self._items.extend(tasks)
+
+    def select(self, es: Any) -> tuple[Any | None, int]:
+        with self._lock:
+            if not self._items:
+                return None, 0
+            i = self._rng.randrange(len(self._items))
+            self._items[i], self._items[-1] = self._items[-1], self._items[i]
+            return self._items.pop(), 0
+
+    def remove(self, context: Any) -> None:
+        self._items = []
+
+    def pending_tasks(self, context: Any) -> int:
+        return len(self._items)
+
+
+# ---------------------------------------------------------------------------
+# ll / llp — per-stream LIFOs with stealing (cf. sched/ll, sched/llp)
+# ---------------------------------------------------------------------------
+
+class LLModule(SchedulerModule):
+    name = "ll"
+    use_priority = False
+
+    def install(self, context: Any) -> None:
+        pass
+
+    def flow_init(self, es: Any) -> None:
+        es.sched_private = (deque(), threading.Lock())
+
+    def schedule(self, es: Any, tasks: Sequence[Any], distance: int = 0) -> None:
+        target = es if es.sched_private is not None else \
+            es.virtual_process.execution_streams[0]
+        dq, lock = target.sched_private
+        with lock:
+            dq.extend(tasks)
+
+    def select(self, es: Any) -> tuple[Any | None, int]:
+        streams = es.virtual_process.execution_streams
+        order = [es] + [s for s in streams if s is not es]
+        for dist, s in enumerate(order):
+            if s.sched_private is None:
+                continue
+            dq, lock = s.sched_private
+            with lock:
+                if not dq:
+                    continue
+                if self.use_priority and s is es:
+                    best = max(range(len(dq)), key=lambda i: dq[i].priority)
+                    t = dq[best]
+                    del dq[best]
+                    return t, 0
+                # own queue: LIFO; victim: FIFO steal
+                return (dq.pop() if s is es else dq.popleft()), min(dist, 1)
+        return None, 0
+
+    def remove(self, context: Any) -> None:
+        for vp in context.virtual_processes:
+            for es in vp.execution_streams:
+                es.sched_private = None
+
+    def pending_tasks(self, context: Any) -> int:
+        n = 0
+        for vp in context.virtual_processes:
+            for es in vp.execution_streams:
+                if es.sched_private is not None:
+                    n += len(es.sched_private[0])
+        return n
+
+
+class LLPModule(LLModule):
+    name = "llp"
+    use_priority = True
+
+
+# ---------------------------------------------------------------------------
+# component registrations (priorities mirror the reference's)
+# ---------------------------------------------------------------------------
+
+def _mk_component(mod_cls: type, prio: int) -> None:
+    @component
+    class _C(Component):
+        type_name = "sched"
+        name = mod_cls.name
+        priority = prio
+
+        def open(self, context: Any = None) -> SchedulerModule:
+            return mod_cls()
+
+    _C.__name__ = f"Sched{mod_cls.name.upper()}Component"
+
+
+_mk_component(LFQModule, 20)
+_mk_component(SPQModule, 18 - 6)   # spq=12 in the reference
+_mk_component(APModule, 12)
+_mk_component(GDModule, 10)
+_mk_component(LLModule, 2)
+_mk_component(LLPModule, 2)
+_mk_component(RNDModule, 1)
+_mk_component(IPModule, 0)

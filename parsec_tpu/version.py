@@ -1,0 +1,4 @@
+"""Version info for parsec-tpu."""
+
+__version__ = "0.1.0"
+API_VERSION = (4, 0)  # tracks the reference API generation (parsec runtime.h v4.0)

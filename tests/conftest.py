@@ -1,0 +1,24 @@
+"""Test harness configuration.
+
+Multi-chip paths are tested on a virtual 8-device CPU mesh (the analog of the
+reference's oversubscribed ``mpiexec -np 8`` CI runs, SURVEY §4).  The session
+environment may pin JAX to a real TPU backend (JAX_PLATFORMS=axon via
+sitecustomize), so we both set the env *and* override the config after import
+— tests must be deterministic and must not occupy the bench chip.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.default_backend()
+assert len(jax.devices()) == 8, jax.devices()

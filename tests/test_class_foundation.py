@@ -1,0 +1,181 @@
+"""Unit tests for the foundation layer (analog of reference tests/class/:
+lifo.c, hash.c, future.c, future_datacopy.c under thread stress)."""
+
+import threading
+
+import pytest
+
+from parsec_tpu.core import (Backoff, ConcurrentHashTable, CountableFuture,
+                             DataCopyFuture, Future, HBBuffer, Mempool)
+
+
+class TestFuture:
+    def test_set_get(self):
+        f = Future()
+        f.set(42)
+        assert f.get() == 42
+        assert f.is_ready()
+
+    def test_double_set_raises(self):
+        f = Future()
+        f.set(1)
+        with pytest.raises(RuntimeError):
+            f.set(2)
+
+    def test_callbacks_fire(self):
+        f = Future()
+        seen = []
+        f.on_ready(lambda fut: seen.append(fut.get()))
+        f.set("x")
+        f.on_ready(lambda fut: seen.append("late"))
+        assert seen == ["x", "late"]
+
+    def test_threaded_get(self):
+        f = Future()
+        out = []
+        t = threading.Thread(target=lambda: out.append(f.get(timeout=5)))
+        t.start()
+        f.set(7)
+        t.join()
+        assert out == [7]
+
+    def test_countable(self):
+        f = CountableFuture(3, combine=lambda a, b: a + b)
+        f.contribute(1)
+        f.contribute(2)
+        assert not f.is_ready()
+        f.contribute(3)
+        assert f.get() == 6
+
+    def test_countable_threaded(self):
+        f = CountableFuture(64, combine=lambda a, b: a + b)
+        ts = [threading.Thread(target=f.contribute, args=(1,)) for _ in range(64)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert f.get() == 64
+
+
+class TestDataCopyFuture:
+    def test_lazy_trigger_on_get(self):
+        calls = []
+        f = DataCopyFuture(convert=lambda _: calls.append(1) or "copy")
+        assert not f.is_ready()
+        assert f.get() == "copy"
+        assert calls == [1]
+
+    def test_nested_reshape_chain(self):
+        base = DataCopyFuture(convert=lambda _: [1, 2, 3])
+        shaped = DataCopyFuture(parent=base, convert=lambda xs: list(reversed(xs)))
+        assert shaped.get() == [3, 2, 1]
+        assert base.is_ready()
+
+    def test_nested_waits_for_parent(self):
+        parent = Future()
+        child = DataCopyFuture(parent=parent, convert=lambda v: v * 2)
+        out = []
+        t = threading.Thread(target=lambda: out.append(child.get(timeout=5)))
+        t.start()
+        parent.set(21)
+        child.trigger()
+        t.join()
+        assert out == [42]
+
+
+class TestHashTable:
+    def test_basic(self):
+        ht = ConcurrentHashTable()
+        ht.insert(("tp", 1), "a")
+        assert ht.get(("tp", 1)) == "a"
+        assert ("tp", 1) in ht
+        assert ht.remove(("tp", 1)) == "a"
+        assert ht.get(("tp", 1)) is None
+
+    def test_find_or_insert_atomic(self):
+        ht = ConcurrentHashTable()
+        created = []
+
+        def worker(k):
+            for i in range(200):
+                ht.find_or_insert((k, i), lambda: created.append(1) or object())
+
+        ts = [threading.Thread(target=worker, args=(j % 4,)) for j in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # every (k, i) pair created exactly once despite 2 threads per k
+        assert len(created) == 4 * 200
+        assert len(ht) == 4 * 200
+
+
+class TestMempool:
+    def test_reuse(self):
+        class Elem:
+            pass
+
+        mp = Mempool(Elem)
+        a = mp.allocate()
+        mp.free(a)
+        b = mp.allocate()
+        assert a is b
+
+    def test_cross_thread_free_returns_to_owner(self):
+        class Elem:
+            pass
+
+        mp = Mempool(Elem)
+        a = mp.allocate()
+        owner = a._mempool_owner
+
+        def free_elsewhere():
+            mp.free(a)
+
+        t = threading.Thread(target=free_elsewhere)
+        t.start()
+        t.join()
+        assert a in owner._free
+
+    def test_reset_hook(self):
+        class Elem:
+            def __init__(self):
+                self.v = 0
+
+        mp = Mempool(Elem, reset=lambda e: setattr(e, "v", 0))
+        a = mp.allocate()
+        a.v = 99
+        mp.free(a)
+        assert mp.allocate().v == 0
+
+
+class TestHBBuffer:
+    def test_spill_to_parent(self):
+        spilled = []
+        hb = HBBuffer(2, parent_push=lambda items, d: spilled.extend(items))
+        hb.push_all([1, 2, 3, 4])
+        assert len(hb) == 2
+        assert spilled == [3, 4]
+
+    def test_pop_best_priority(self):
+        hb = HBBuffer(8, parent_push=lambda i, d: None)
+        hb.push_all([3, 1, 9, 4])
+        assert hb.try_pop_best(priority=lambda x: x) == 9
+        assert hb.try_pop_best() == 4  # LIFO without priority fn
+
+    def test_steal_from_old_end(self):
+        hb = HBBuffer(8, parent_push=lambda i, d: None)
+        hb.push_all([1, 2, 3])
+        assert hb.steal() == 1
+
+
+def test_backoff_grows_and_resets():
+    b = Backoff(base_ns=10, max_ns=40)
+    b.wait()  # first miss only arms it
+    assert b._cur_ns == 10
+    b.wait()
+    b.wait()
+    b.wait()
+    assert b._cur_ns == 40
+    b.reset()
+    assert b._cur_ns == 0

@@ -1,0 +1,92 @@
+"""Tests for the MCA param system and component registry (SURVEY §5.6, §2.4)."""
+
+import pytest
+
+from parsec_tpu.core.mca import Component, ComponentRepository
+from parsec_tpu.core.params import ParamRegistry
+
+
+class TestParams:
+    def test_register_default(self):
+        reg = ParamRegistry()
+        p = reg.register("runtime_num_cores", 4, "worker thread count")
+        assert p.value == 4 and p.source == "default"
+        assert reg.get("runtime_num_cores") == 4
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("PARSEC_MCA_sched", "spq")
+        reg = ParamRegistry()
+        reg.register("sched", "lfq", "scheduler component")
+        assert reg.get("sched") == "spq"
+
+    def test_cli_override_beats_env(self, monkeypatch):
+        monkeypatch.setenv("PARSEC_MCA_sched", "spq")
+        reg = ParamRegistry()
+        reg.register("sched", "lfq")
+        rest = reg.parse_cmdline(["prog", "--mca", "sched", "gd", "-x"])
+        assert rest == ["prog", "-x"]
+        assert reg.get("sched") == "gd"
+
+    def test_paramfile(self, tmp_path):
+        f = tmp_path / "mca.conf"
+        f.write_text("# comment\ncomm_yield_ns = 500\n")
+        reg = ParamRegistry()
+        reg.parse_paramfile(str(f))
+        reg.register("comm_yield_ns", 100)
+        assert reg.get("comm_yield_ns") == 500
+
+    def test_typed_conversion(self, monkeypatch):
+        monkeypatch.setenv("PARSEC_MCA_device_tpu_enabled", "true")
+        reg = ParamRegistry()
+        reg.register("device_tpu_enabled", False)
+        assert reg.get("device_tpu_enabled") is True
+
+    def test_set_and_readonly(self):
+        reg = ParamRegistry()
+        reg.register("window", 2048)
+        reg.set("window", 16)
+        assert reg.get("window") == 16
+        reg.register("fixed", 1, read_only=True)
+        with pytest.raises(PermissionError):
+            reg.set("fixed", 2)
+
+    def test_dump_lists_all(self):
+        reg = ParamRegistry()
+        reg.register("a", 1, "first")
+        reg.register("b", "x", "second")
+        d = reg.dump()
+        assert "a = 1" in d and "second" in d
+
+
+class TestMCA:
+    def _mk(self, type_name, name, priority, accepts=True):
+        class C(Component):
+            pass
+
+        c = C()
+        c.type_name, c.name, c.priority = type_name, name, priority
+        c.query = lambda ctx=None: accepts
+        return c
+
+    def test_priority_selection(self):
+        repo = ComponentRepository()
+        repo.register(self._mk("sched", "low", 5))
+        best = self._mk("sched", "high", 20)
+        repo.register(best)
+        assert repo.query("sched", requested="") is best
+
+    def test_query_skips_rejecting(self):
+        repo = ComponentRepository()
+        repo.register(self._mk("sched", "broken", 99, accepts=False))
+        ok = self._mk("sched", "ok", 1)
+        repo.register(ok)
+        assert repo.query("sched", requested="") is ok
+
+    def test_explicit_request(self):
+        repo = ComponentRepository()
+        lo = self._mk("sched", "lo", 1)
+        repo.register(lo)
+        repo.register(self._mk("sched", "hi", 50))
+        assert repo.query("sched", requested="lo") is lo
+        with pytest.raises(LookupError):
+            repo.query("sched", requested="nope")
