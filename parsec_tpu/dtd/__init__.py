@@ -1,0 +1,17 @@
+"""DTD: Dynamic Task Discovery front-end.
+
+Rebuild of ``parsec/interfaces/dtd/`` (SURVEY §2.8): tasks are inserted at
+runtime (``parsec_dtd_insert_task``, ``insert_function.h:53-411``) and the
+dependency graph is discovered from per-tile last-user / last-writer access
+chains (RAW/WAR/WAW), with a sliding insertion window for backpressure.
+"""
+
+from .insert import (AFFINITY, DONT_TRACK, INOUT, INPUT, OUTPUT, PULLIN,
+                     PUSHOUT, REF, SCRATCH, VALUE, DTDTaskpool, DTDTile,
+                     Scratch, unpack_args)
+
+__all__ = [
+    "DTDTaskpool", "DTDTile", "Scratch", "unpack_args",
+    "INPUT", "OUTPUT", "INOUT", "VALUE", "SCRATCH", "REF",
+    "AFFINITY", "DONT_TRACK", "PUSHOUT", "PULLIN",
+]

@@ -1,0 +1,473 @@
+"""DTD engine: runtime task insertion with discovered dependencies.
+
+Rebuild of ``parsec/interfaces/dtd/insert_function.c`` (SURVEY §2.8, §3.6):
+
+- ``insert_task(body, (tile, INOUT), (x, VALUE), ...)`` — the analog of
+  ``parsec_dtd_insert_task`` (``insert_function.h:53-70``): flags describe
+  each argument's role; data arguments thread through per-tile
+  ``last_writer`` / ``last_user`` accessor records
+  (``SET_LAST_ACCESSOR``, ``insert_function_internal.h:55-68``) to discover
+  RAW / WAR / WAW edges at insert time.
+- ``tile_of(dc, key)`` — per-collection tile table
+  (``parsec_dtd_tile_of``, ``insert_function.c:1260``).
+- sliding window — when more than ``dtd_window_size`` tasks are in flight the
+  inserting thread joins execution until below ``dtd_threshold_size``
+  (``parsec_execute_and_come_back``, ``insert_function.c:570``).
+- ``data_flush`` — inserts a flush task pushing the final tile version back
+  to its home copy/rank (``parsec_dtd_data_flush.c``).
+
+TPU-first notes: a task body may carry a TPU incarnation (a kernel-registry
+name) next to the Python host body, exactly like the reference's per-chore
+CUDA bodies; in-place mutation works on host numpy tiles, while device/jax
+bodies return replacement arrays (functional update — the XLA-native
+convention) which the engine writes back to the tile copy.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable
+
+import numpy as np
+
+from ..core.params import params as _params
+from ..data.data import (ACCESS_READ, ACCESS_RW, ACCESS_WRITE, DataCopy,
+                         data_create)
+from ..data.datatype import TileType
+from ..prof import pins
+from ..prof.pins import PinsEvent
+from ..runtime.scheduling import schedule_tasks
+from ..runtime.task import (DEV_CPU, DEV_TPU, HOOK_RETURN_DONE, Chore, Flow,
+                            Task, TaskClass)
+from ..runtime.taskpool import Taskpool
+
+# ---------------------------------------------------------------------------
+# argument flags (cf. insert_function.h:53-70; region index in low bits there,
+# here region/layout rides on the tile itself)
+# ---------------------------------------------------------------------------
+INPUT = ACCESS_READ
+OUTPUT = ACCESS_WRITE
+INOUT = ACCESS_RW
+_MODE_MASK = 0x3
+
+VALUE = 0x10        # pass by value (copied at insert time)
+SCRATCH = 0x20      # per-task scratch allocation
+REF = 0x40          # pass the object reference untracked
+
+AFFINITY = 0x100    # this argument's tile decides the executing rank
+DONT_TRACK = 0x200  # do not thread dependencies through this argument
+PUSHOUT = 0x400     # eagerly push the written tile back to its home
+PULLIN = 0x800      # eagerly pull the tile to the executing device
+
+_params.register("dtd_window_size", 2048,
+                 "max in-flight inserted tasks before the inserter "
+                 "joins execution (parsec_dtd_window_size)")
+_params.register("dtd_threshold_size", 1024,
+                 "in-flight level at which the inserter resumes "
+                 "(parsec_dtd_threshold_size)")
+
+_MAX_TASK_CLASSES = 25  # PARSEC_DTD_NB_TASK_CLASSES (insert_function_internal.h:31)
+
+
+class Scratch:
+    """Scratch-argument descriptor: ``(Scratch(shape, dtype), SCRATCH)``."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype=np.float32) -> None:
+        self.shape = tuple(shape) if not isinstance(shape, int) else (shape,)
+        self.dtype = np.dtype(dtype)
+
+
+class DTDTile:
+    """One trackable datum with its accessor chain (cf. ``parsec_dtd_tile_t``).
+
+    ``last_writer`` / ``last_users`` implement the reference's
+    ``SET_LAST_ACCESSOR`` discipline: a new reader depends on the last writer
+    and joins ``last_users``; a new writer depends on the last writer (WAW)
+    *and* every reader since (WAR), then resets the chain.
+    """
+
+    __slots__ = ("data", "dc", "key", "last_writer", "last_users", "_lock",
+                 "flushed")
+
+    def __init__(self, data: Any, dc: Any = None, key: tuple = ()) -> None:
+        self.data = data              # the master Data record
+        self.dc = dc                  # owning collection, if any
+        self.key = key
+        self.last_writer: tuple[DTDTask, int] | None = None
+        self.last_users: list[tuple[DTDTask, int]] = []
+        self._lock = threading.Lock()
+        self.flushed = False
+
+    @property
+    def rank(self) -> int:
+        return self.dc.rank_of(*self.key) if self.dc is not None else 0
+
+    def __repr__(self) -> str:
+        return f"<DTDTile {self.key or self.data.key}>"
+
+
+class _ArgSpec:
+    __slots__ = ("obj", "flags", "mode", "flow_index")
+
+    def __init__(self, obj: Any, flags: int) -> None:
+        self.obj = obj
+        self.flags = flags
+        self.mode = flags & _MODE_MASK
+        self.flow_index = -1   # set for data args
+
+
+class DTDTask(Task):
+    """A dynamically-inserted task with per-instance discovered deps."""
+
+    __slots__ = ("body", "args", "deps_pending", "successors", "completed",
+                 "_dlock", "tiles")
+
+    def __init__(self, taskpool: Any, task_class: TaskClass, body: Callable,
+                 args: list[_ArgSpec], priority: int = 0) -> None:
+        super().__init__(taskpool, task_class, {"uid": 0}, priority=priority)
+        self.locals = {"uid": self.uid}
+        self.body = body
+        self.args = args
+        # +1 insertion guard: dropped when all deps are linked (SURVEY §3.6)
+        self.deps_pending = 1
+        # (successor_task, successor_flow_index) release records
+        self.successors: list[tuple[DTDTask, int]] = []
+        self.completed = False
+        self._dlock = threading.Lock()
+        self.tiles: list[DTDTile | None] = [None] * len(task_class.flows)
+
+    def unpack_args(self) -> list[Any]:
+        """``parsec_dtd_unpack_args``: resolved argument values in insert
+        order — data/scratch args as arrays, VALUE/REF args as-is."""
+        out = []
+        for spec in self.args:
+            if spec.flags & (VALUE | REF):
+                out.append(spec.obj)
+            elif spec.flags & SCRATCH:
+                out.append(self.data[spec.flow_index])
+            else:
+                copy = self.data[spec.flow_index]
+                out.append(copy.value if copy is not None else None)
+        return out
+
+
+def unpack_args(task: DTDTask) -> list[Any]:
+    return task.unpack_args()
+
+
+class _DTDTaskClass(TaskClass):
+    """Dynamic task class (cf. ``parsec_dtd_create_task_class``): flows are
+    positional slots; successor iteration walks per-instance records, so the
+    class-level guarded-dep machinery is bypassed."""
+
+    def make_key(self, locals_: dict) -> tuple:
+        return (locals_["uid"],)
+
+    def iterate_successors(self, task: Task, visitor: Callable) -> None:
+        # DTD releases through instance records (complete_hook_of_dtd,
+        # insert_function.c:1797); nothing for the generic walker to do.
+        return
+
+
+def _dtd_cpu_hook(es: Any, task: DTDTask) -> int:
+    values = task.unpack_args()
+    result = task.body(*values)
+    _apply_result(task, result)
+    return HOOK_RETURN_DONE
+
+
+def _dtd_prepare_input(es: Any, task: DTDTask) -> None:
+    """DTD data lookup: tracked flows carry their copies from insert time;
+    SCRATCH flows allocate per-execution temporaries here."""
+    for spec in task.args:
+        if spec.flags & SCRATCH and task.data[spec.flow_index] is None:
+            task.data[spec.flow_index] = np.zeros(spec.obj.shape,
+                                                  dtype=spec.obj.dtype)
+
+
+def _apply_result(task: DTDTask, result: Any) -> None:
+    """Functional-update write-back: a body returning a tuple/array replaces
+    the values of its written flows in order (jax-style); ``None`` means the
+    body mutated host arrays in place."""
+    if result is None:
+        return
+    written = [s for s in task.args
+               if s.flow_index >= 0 and not (s.flags & SCRATCH)
+               and (s.mode & ACCESS_WRITE)]
+    results = result if isinstance(result, (tuple, list)) else (result,)
+    if len(results) != len(written):
+        raise ValueError(
+            f"{task}: body returned {len(results)} values for "
+            f"{len(written)} written flows")
+    for spec, value in zip(written, results):
+        copy = task.data[spec.flow_index]
+        copy.value = value
+
+
+def _dtd_flush_body(arr, tile: "DTDTile") -> None:
+    home = tile.data.get_copy(0)
+    newest = tile.data.newest_copy()
+    if newest is not None and home is not None and newest is not home:
+        home.value = np.asarray(newest.value)
+        home.version = newest.version
+    tile.flushed = True
+
+
+class DTDTaskpool(Taskpool):
+    """``parsec_dtd_taskpool_new``: a taskpool whose DAG is discovered from
+    the insertion order of tasks touching shared tiles."""
+
+    def __init__(self, name: str = "dtd") -> None:
+        super().__init__(name=name)
+        self._classes: dict[Any, _DTDTaskClass] = {}
+        self._tiles: dict[tuple, DTDTile] = {}
+        self._tlock = threading.Lock()
+        self._inflight = 0
+        self._icond = threading.Condition()
+        self._armed = False
+        self.window_size = _params.get("dtd_window_size")
+        self.threshold_size = _params.get("dtd_threshold_size")
+
+    # ------------------------------------------------------------- lifecycle
+    def startup(self, context: Any) -> list[Task]:
+        # Hold one pending action until wait(): task counts are unknown until
+        # the app stops inserting (the DTD termdet discipline, §3.6).
+        self.tdm.taskpool_addto_nb_pa(+1)
+        self._armed = True
+        return []
+
+    def nb_local_tasks(self) -> int:
+        return -1
+
+    def wait(self, timeout: float | None = None) -> None:
+        """``parsec_dtd_taskpool_wait``: no more insertions; drain."""
+        if self._armed:
+            self._armed = False
+            self.tdm.taskpool_addto_nb_pa(-1)
+        super().wait(timeout)
+
+    # ----------------------------------------------------------------- tiles
+    def tile_of(self, dc: Any, *key) -> DTDTile:
+        """``parsec_dtd_tile_of``: the unique tile record for ``dc(key)``."""
+        k = (id(dc),) + key
+        with self._tlock:
+            t = self._tiles.get(k)
+            if t is None:
+                t = DTDTile(dc.data_of(*key), dc=dc, key=key)
+                self._tiles[k] = t
+            return t
+
+    def tile_of_array(self, array: Any, key: Any = None) -> DTDTile:
+        """Tile over a bare array (tests/small apps; no collection)."""
+        k = ("arr", id(array) if key is None else key)
+        with self._tlock:
+            t = self._tiles.get(k)
+            if t is None:
+                t = DTDTile(data_create(array, key=k))
+                self._tiles[k] = t
+            return t
+
+    # -------------------------------------------------------------- classes
+    def _class_for(self, body: Callable, specs: list[_ArgSpec],
+                   name: str | None, tpu_kernel: str | None) -> _DTDTaskClass:
+        # access modes are part of the class identity: the same body inserted
+        # with different INPUT/OUTPUT roles must not reuse baked-in flows
+        modes = tuple(s.flags & (_MODE_MASK | SCRATCH) for s in specs
+                      if not (s.flags & (VALUE | REF)))
+        ck = (body, modes, tpu_kernel)
+        tc = self._classes.get(ck)
+        if tc is not None:
+            return tc
+        if len(self._classes) >= _MAX_TASK_CLASSES:
+            raise RuntimeError(
+                f"too many DTD task classes (max {_MAX_TASK_CLASSES})")
+        flows = []
+        fi = 0
+        for s in specs:
+            if s.flags & (VALUE | REF):
+                continue
+            access = ACCESS_RW if s.flags & SCRATCH else s.mode
+            flows.append(Flow(f"f{fi}", access))
+            fi += 1
+        chores = []
+        if tpu_kernel is not None:
+            from ..device.hooks import make_device_hook
+            chores.append(Chore(
+                DEV_TPU, hook=make_device_hook(DEV_TPU, None, tpu_kernel),
+                dyld=tpu_kernel))
+        chores.append(Chore(DEV_CPU, hook=_dtd_cpu_hook))
+        tc = _DTDTaskClass(name or getattr(body, "__name__", "dtd_task"),
+                           params=["uid"], flows=flows, chores=chores)
+        tc.prepare_input = _dtd_prepare_input
+        tc.complete_execution = lambda es, t: t.taskpool.release_task(es, t)
+        self.add_task_class(tc)
+        self._classes[ck] = tc
+        return tc
+
+    # --------------------------------------------------------------- insert
+    def insert_task(self, body: Callable, *args: Any,
+                    name: str | None = None, priority: int = 0,
+                    tpu_kernel: str | None = None) -> DTDTask:
+        """``parsec_dtd_insert_task``.  Each argument is either a bare value
+        (treated as VALUE) or a tuple ``(obj, flags)``; data arguments are
+        :class:`DTDTile` (or arrays, auto-wrapped via :meth:`tile_of_array`).
+        """
+        if self.context is None:
+            raise RuntimeError("taskpool not enqueued in a context")
+        specs: list[_ArgSpec] = []
+        for a in args:
+            if isinstance(a, tuple) and len(a) == 2 and isinstance(a[1], int):
+                obj, flags = a
+            else:
+                obj, flags = a, VALUE
+            if flags & AFFINITY and self.context.nb_ranks > 1:
+                # rank routing of DTD tasks needs the remote-shell protocol;
+                # fail loudly rather than silently running on the wrong rank
+                raise NotImplementedError(
+                    "DTD AFFINITY across ranks is not wired up yet")
+            if not (flags & (VALUE | SCRATCH | REF)):
+                if isinstance(obj, np.ndarray):
+                    obj = self.tile_of_array(obj)
+                elif not isinstance(obj, DTDTile):
+                    raise TypeError(
+                        f"data argument must be a DTDTile or ndarray, "
+                        f"got {type(obj).__name__}")
+            specs.append(_ArgSpec(obj, flags))
+        tc = self._class_for(body, specs, name, tpu_kernel)
+        task = DTDTask(self, tc, body, specs, priority=priority)
+        self.tdm.taskpool_addto_nb_tasks(+1)
+        with self._icond:
+            self._inflight += 1
+
+        # thread dependencies through each tracked data argument
+        fi = 0
+        for spec in specs:
+            if spec.flags & (VALUE | REF):
+                continue
+            spec.flow_index = fi
+            fi += 1
+            if spec.flags & SCRATCH:
+                continue
+            tile: DTDTile = spec.obj
+            task.tiles[spec.flow_index] = tile
+            if spec.flags & DONT_TRACK:
+                self._attach_tile_copy(task, spec, tile)
+                continue
+            self._link_tile(task, spec, tile)
+
+        ready = False
+        with task._dlock:
+            task.deps_pending -= 1  # drop the insertion guard
+            ready = task.deps_pending == 0
+        if ready:
+            task.status = "ready"
+            schedule_tasks(self.context._submit_es, [task], 0)
+        self._window_backpressure()
+        return task
+
+    def _attach_tile_copy(self, task: DTDTask, spec: _ArgSpec,
+                          tile: DTDTile) -> None:
+        copy = tile.data.newest_copy()
+        if copy is None:
+            raise RuntimeError(f"{tile}: no valid copy")
+        task.data[spec.flow_index] = copy
+
+    def _link_tile(self, task: DTDTask, spec: _ArgSpec, tile: DTDTile) -> None:
+        """The SET_LAST_ACCESSOR walk: register RAW/WAR/WAW edges from the
+        tile's previous accessors to ``task``."""
+        deps: list[DTDTask] = []
+        with tile._lock:
+            lw = tile.last_writer
+            if spec.mode == INPUT:
+                if lw is not None:
+                    deps.append(lw[0])
+                tile.last_users.append((task, spec.flow_index))
+            else:  # OUTPUT and INOUT both serialize against the chain
+                if lw is not None:
+                    deps.append(lw[0])          # WAW (and RAW for INOUT)
+                for (u, _) in tile.last_users:   # WAR
+                    if u is not task:
+                        deps.append(u)
+                tile.last_users = []
+                tile.last_writer = (task, spec.flow_index)
+        self._attach_tile_copy(task, spec, tile)
+        for pred in deps:
+            self._link_dep(pred, task)
+
+    def _link_dep(self, pred: DTDTask, succ: DTDTask) -> None:
+        if pred is succ:
+            return
+        with pred._dlock:
+            if not pred.completed:
+                with succ._dlock:
+                    succ.deps_pending += 1
+                pred.successors.append((succ, -1))
+
+    # ------------------------------------------------------------ completion
+    def release_task(self, es: Any, task: DTDTask) -> None:
+        """``complete_hook_of_dtd`` → ``dtd_release_dep_fct``: bump written
+        tile versions, release instance successors, notify the window."""
+        pins.fire(PinsEvent.RELEASE_DEPS_BEGIN, es, task)
+        for spec in task.args:
+            if spec.flow_index < 0 or spec.flags & SCRATCH:
+                continue
+            if spec.mode & ACCESS_WRITE:
+                copy = task.data[spec.flow_index]
+                if copy is not None:
+                    copy.version += 1
+        with task._dlock:
+            task.completed = True
+            succs = list(task.successors)
+            task.successors.clear()
+        ready = []
+        for (succ, _) in succs:
+            with succ._dlock:
+                succ.deps_pending -= 1
+                if succ.deps_pending == 0:
+                    succ.status = "ready"
+                    ready.append(succ)
+        pins.fire(PinsEvent.RELEASE_DEPS_END, es, task)
+        if ready:
+            schedule_tasks(es, ready, 0)
+        with self._icond:
+            self._inflight -= 1
+            self._icond.notify_all()
+
+    # --------------------------------------------------------------- window
+    def _window_backpressure(self) -> None:
+        """``parsec_execute_and_come_back``: above ``window_size`` in-flight
+        tasks the inserter pitches in (no workers) or blocks (workers)."""
+        if self._inflight <= self.window_size:
+            return
+        ctx = self.context
+        if not ctx.started:
+            # insertion demands progress: release parked workers (the
+            # execute-and-come-back contract cannot hold otherwise)
+            ctx.start()
+        if ctx._threads:
+            with self._icond:
+                self._icond.wait_for(
+                    lambda: self._inflight <= self.threshold_size)
+        else:
+            ctx._drive_until(
+                lambda: self._inflight <= self.threshold_size)
+
+    # ---------------------------------------------------------------- flush
+    def data_flush(self, tile: DTDTile) -> None:
+        """``parsec_dtd_data_flush``: insert a task after every current
+        accessor that writes the final version back to the tile's home.
+
+        One shared task class serves every flush (the tile rides as an
+        untracked REF arg) — flushes must not consume class slots."""
+        self.insert_task(_dtd_flush_body, (tile, INPUT), (tile, REF),
+                         name="dtd_flush")
+
+    def data_flush_all(self) -> None:
+        """``parsec_dtd_data_flush_all`` over every tile seen so far."""
+        with self._tlock:
+            tiles = list(self._tiles.values())
+        for t in tiles:
+            self.data_flush(t)
