@@ -1,0 +1,28 @@
+"""Communication: comm-engine abstraction + remote-dep protocol.
+
+Rebuild of the reference's communication stack (SURVEY §2.6, §3.4, §5.8):
+
+- :mod:`engine` — the transport-neutral comm-engine vtable
+  (``parsec_comm_engine.h:176-199``): active messages, registered memory,
+  one-sided get/put, progress; with the in-process fabric backend (the
+  rebuild's analog of oversubscribed-MPI CI runs) and the seam where an
+  ICI/DCN transport slots in.
+- :mod:`remote_dep` — the remote dependency-activation protocol
+  (``remote_dep.c`` / ``remote_dep_mpi.c``): activation AMs carrying task
+  coordinates, rendezvous GET for payloads, short-message inlining,
+  binomial/chain/star propagation trees, per-peer coalescing, and the
+  termination-detection pending-action discipline.
+- :mod:`multirank` — N-rank harness: one runtime context per rank over a
+  shared fabric (the test-facing analog of ``mpiexec -np N``).
+"""
+
+from .engine import (AM_TAG_ACTIVATE, AM_TAG_GET_ACK, AM_TAG_TERMDET,
+                     CommEngine, InprocFabric, MemHandle)
+from .remote_dep import RemoteDepEngine, RemoteDeps
+from .multirank import run_multirank
+
+__all__ = [
+    "CommEngine", "InprocFabric", "MemHandle", "RemoteDepEngine",
+    "RemoteDeps", "run_multirank", "AM_TAG_ACTIVATE", "AM_TAG_GET_ACK",
+    "AM_TAG_TERMDET",
+]

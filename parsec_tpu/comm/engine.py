@@ -1,0 +1,267 @@
+"""The comm-engine abstraction and the in-process fabric backend.
+
+Rebuild of ``parsec_comm_engine.h`` (SURVEY §2.6): a transport exposes
+
+- **active messages** — ``tag_register(tag, cb)`` + ``send_am(tag, dst,
+  payload)``: small fixed-role control messages delivered by invoking the
+  registered callback on the receiver during its ``progress()``
+  (``parsec_comm_engine.h:60-93``);
+- **registered memory + one-sided get** — ``mem_register`` publishes a local
+  buffer under a :class:`MemHandle`; a peer pulls it with :meth:`get`
+  (rendezvous protocol, ``parsec_comm_engine.h:95-113``), completion invoking
+  a local callback and an optional remote-completion AM;
+- **progress** — drains incoming traffic; never called concurrently for one
+  engine (the funnelled discipline of ``parsec_mpi_funnelled.c``).
+
+Reserved AM tags mirror ``parsec_comm_engine.h:24-40``.
+
+Backends:
+
+- :class:`InprocCommEngine` over :class:`InprocFabric` — N ranks inside one
+  process with per-rank message queues.  This is the rebuild's analog of the
+  reference's oversubscribed-MPI test runs (SURVEY §4): the *protocol* layer
+  (remote_dep) is exercised unchanged; only the byte transport is local.
+  ``get`` copies the source buffer (the stand-in for an ICI DMA read).
+- A multi-host ICI/DCN backend implements the same vtable with activation
+  AMs over DCN and payload movement as device-to-device transfers
+  (jax ``device_put`` across hosts / XLA collectives for the regular
+  patterns); see §5.8 of SURVEY.md for the mapping.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import deque
+from typing import Any, Callable
+
+import numpy as np
+
+# Reserved AM tags (cf. parsec_comm_engine.h:24-40).
+AM_TAG_GET_REQ = 1       # internal: rendezvous pull request
+AM_TAG_GET_REPLY = 2     # internal: rendezvous payload delivery
+AM_TAG_GET_ACK = 3       # remote-completion notification after a get
+AM_TAG_ACTIVATE = 4      # remote-dep activation
+AM_TAG_TERMDET = 5       # termination-detection waves (fourcounter)
+AM_TAG_BARRIER = 6       # context-level sync barrier
+AM_TAG_USER_BASE = 16    # first tag available to applications/DSLs
+
+
+class Capabilities:
+    """What a backend supports (cf. ``parsec_comm_engine_capabilities_t``)."""
+
+    __slots__ = ("sided", "multithreaded", "supports_noncontiguous")
+
+    def __init__(self, sided: int = 1, multithreaded: bool = True,
+                 supports_noncontiguous: bool = True) -> None:
+        self.sided = sided
+        self.multithreaded = multithreaded
+        self.supports_noncontiguous = supports_noncontiguous
+
+
+class MemHandle:
+    """A published local buffer (cf. ``mem_register`` handles).
+
+    ``refcount`` counts peers still expected to pull; the publisher drops the
+    registration when it reaches zero (the ``mem_unregister`` moment).
+    """
+
+    __slots__ = ("handle_id", "rank", "value", "refcount", "on_drained")
+
+    _ids = itertools.count(1)
+
+    def __init__(self, rank: int, value: Any, refcount: int = 1,
+                 on_drained: Callable[[], None] | None = None) -> None:
+        self.handle_id = next(MemHandle._ids)
+        self.rank = rank
+        self.value = value
+        self.refcount = refcount
+        self.on_drained = on_drained
+
+    def wire(self) -> tuple[int, int]:
+        """The on-the-wire form: (owner rank, handle id)."""
+        return (self.rank, self.handle_id)
+
+
+class InprocFabric:
+    """Process-global N-rank fabric: per-rank inboxes + engine registry."""
+
+    def __init__(self, nranks: int) -> None:
+        self.nranks = nranks
+        self._inboxes: list[deque] = [deque() for _ in range(nranks)]
+        self._locks = [threading.Lock() for _ in range(nranks)]
+        self.engines: list["InprocCommEngine | None"] = [None] * nranks
+
+    def attach(self, rank: int) -> "InprocCommEngine":
+        eng = InprocCommEngine(self, rank)
+        self.engines[rank] = eng
+        return eng
+
+    def deliver(self, dst: int, tag: int, src: int, payload: Any) -> None:
+        with self._locks[dst]:
+            self._inboxes[dst].append((tag, src, payload))
+
+    def drain(self, rank: int, limit: int = 64) -> list[tuple]:
+        out = []
+        with self._locks[rank]:
+            while self._inboxes[rank] and len(out) < limit:
+                out.append(self._inboxes[rank].popleft())
+        return out
+
+    def pending(self, rank: int) -> int:
+        with self._locks[rank]:
+            return len(self._inboxes[rank])
+
+
+class CommEngine:
+    """The abstract vtable (``parsec_comm_engine.h:176-199``)."""
+
+    capabilities = Capabilities()
+
+    def __init__(self, nranks: int, rank: int) -> None:
+        self.nranks = nranks
+        self.rank = rank
+        self._am_callbacks: dict[int, Callable] = {}
+        self._mem: dict[int, MemHandle] = {}
+        self._mem_lock = threading.Lock()
+        self._enabled = False
+
+    # -- active messages ----------------------------------------------------
+    def tag_register(self, tag: int, cb: Callable[[Any, int, Any], None]) -> None:
+        """``cb(engine, src_rank, payload)`` runs during ``progress``."""
+        self._am_callbacks[tag] = cb
+
+    def send_am(self, tag: int, dst: int, payload: Any) -> None:
+        raise NotImplementedError
+
+    # -- registered memory / one-sided ---------------------------------------
+    def mem_register(self, value: Any, refcount: int = 1,
+                     on_drained: Callable[[], None] | None = None) -> MemHandle:
+        h = MemHandle(self.rank, value, refcount, on_drained)
+        with self._mem_lock:
+            self._mem[h.handle_id] = h
+        return h
+
+    def mem_retrieve(self, handle_id: int) -> MemHandle | None:
+        with self._mem_lock:
+            return self._mem.get(handle_id)
+
+    def mem_release(self, handle_id: int) -> None:
+        """Drop one reference; unregister when drained."""
+        with self._mem_lock:
+            h = self._mem.get(handle_id)
+            if h is None:
+                return
+            h.refcount -= 1
+            if h.refcount > 0:
+                return
+            del self._mem[handle_id]
+        if h.on_drained is not None:
+            h.on_drained()
+
+    def get(self, rwire: tuple[int, int],
+            on_complete: Callable[[Any], None]) -> None:
+        """One-sided pull of the remote buffer named by ``rwire``;
+        ``on_complete(value)`` runs locally when the payload has landed."""
+        raise NotImplementedError
+
+    # -- lifecycle / progress -------------------------------------------------
+    def enable(self) -> None:
+        self._enabled = True
+
+    def progress(self) -> int:
+        """Drain incoming traffic; returns number of events handled."""
+        raise NotImplementedError
+
+    def pending(self) -> int:
+        """Number of undelivered incoming events (0 if unknowable)."""
+        return 0
+
+    def sync(self) -> None:
+        """Barrier across ranks (collective; used at context teardown)."""
+        raise NotImplementedError
+
+    def fini(self) -> None:
+        pass
+
+
+class InprocCommEngine(CommEngine):
+    """N ranks in one process (the oversubscribed-MPI analog, SURVEY §4)."""
+
+    def __init__(self, fabric: InprocFabric, rank: int) -> None:
+        super().__init__(fabric.nranks, rank)
+        self.fabric = fabric
+        self._pending_gets: dict[int, Callable] = {}
+        self._get_ids = itertools.count(1)
+        self._barrier_seen: dict[int, set] = {}
+        self._barrier_gen = 0
+        self.tag_register(AM_TAG_GET_REQ, self._serve_get)
+        self.tag_register(AM_TAG_GET_REPLY, self._finish_get)
+        self.tag_register(AM_TAG_BARRIER, self._on_barrier)
+
+    # -- AM -------------------------------------------------------------------
+    def send_am(self, tag: int, dst: int, payload: Any) -> None:
+        # self-sends also go through the inbox so the callback runs from
+        # progress(), never from the sender's stack
+        self.fabric.deliver(dst, tag, self.rank, payload)
+
+    # -- one-sided get: rendezvous through internal AMs ----------------------
+    # (the same emulation the reference's MPI backend uses: GET req AM →
+    #  source replies with the payload, parsec_mpi_funnelled.c:247,980)
+    def get(self, rwire: tuple[int, int],
+            on_complete: Callable[[Any], None]) -> None:
+        owner, handle_id = rwire
+        get_id = next(self._get_ids)
+        self._pending_gets[get_id] = on_complete
+        self.send_am(AM_TAG_GET_REQ, owner,
+                     {"handle": handle_id, "get_id": get_id,
+                      "reply_to": self.rank})
+
+    def _serve_get(self, eng: CommEngine, src: int, msg: dict) -> None:
+        h = self.mem_retrieve(msg["handle"])
+        if h is None:
+            raise RuntimeError(
+                f"rank {self.rank}: GET for unknown handle {msg['handle']}")
+        value = h.value
+        # the DMA copy: the receiver must own its bytes (ICI read analog)
+        if isinstance(value, np.ndarray):
+            value = value.copy()
+        self.send_am(AM_TAG_GET_REPLY, msg["reply_to"],
+                     {"get_id": msg["get_id"], "value": value})
+        self.mem_release(msg["handle"])
+
+    def _finish_get(self, eng: CommEngine, src: int, msg: dict) -> None:
+        cb = self._pending_gets.pop(msg["get_id"])
+        cb(msg["value"])
+
+    # -- progress -------------------------------------------------------------
+    def pending(self) -> int:
+        return self.fabric.pending(self.rank)
+
+    def progress(self) -> int:
+        n = 0
+        for tag, src, payload in self.fabric.drain(self.rank):
+            cb = self._am_callbacks.get(tag)
+            if cb is None:
+                raise RuntimeError(f"no callback for AM tag {tag}")
+            cb(self, src, payload)
+            n += 1
+        return n
+
+    def _on_barrier(self, eng: CommEngine, src: int, msg: dict) -> None:
+        self._barrier_seen.setdefault(msg["gen"], set()).add(src)
+
+    def sync(self) -> None:
+        """All-ranks barrier over AMs, progressing while waiting."""
+        import time
+        gen = self._barrier_gen = self._barrier_gen + 1
+        seen = self._barrier_seen.setdefault(gen, set())
+        for r in range(self.nranks):
+            if r != self.rank:
+                self.send_am(AM_TAG_BARRIER, r, {"gen": gen})
+        deadline = time.monotonic() + 30.0
+        while len(seen) < self.nranks - 1:
+            self.progress()
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"rank {self.rank} barrier timeout")
+        del self._barrier_seen[gen]

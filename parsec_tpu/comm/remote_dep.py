@@ -1,0 +1,374 @@
+"""Remote dependency activation: release_deps across ranks.
+
+Rebuild of ``remote_dep.c`` / ``remote_dep_mpi.c`` (SURVEY §3.4):
+
+- the producer's ``release_deps`` accumulates per-output **rank bitmaps**
+  into a :class:`RemoteDeps` record (``parsec_remote_deps_t``,
+  ``remote_dep.h:132-153``) instead of releasing locally;
+- :meth:`RemoteDepEngine.activate` packs a wire activation
+  {taskpool comm-id, task-class id, locals, output mask, payload
+  descriptors} (``remote_dep_wire_activate_t``, ``remote_dep.h:42-50``),
+  **inlines short payloads** (``remote_dep_mpi_pack_dep:1270``), registers
+  larger ones for rendezvous GET, and sends it down a **propagation tree**
+  (binomial / chain / star, ``remote_dep.c:320-358``) re-derived
+  deterministically at each hop from the sorted participant list;
+- the receiver reconstructs the *ghost predecessor task* and re-runs its
+  successor iterator restricted to this rank to learn where each payload
+  lands (``remote_dep_get_datatypes:820``), pulls non-inline payloads
+  (``remote_dep_mpi_get_start:2042``), then releases local successors into
+  the scheduler (``remote_dep_release_incoming:955``) and forwards to its
+  tree children (``parsec_remote_dep_propagate:409``);
+- every in-flight activation holds a **pending action** on the producing
+  taskpool's termination detector, dropped when the consumer acknowledges
+  (``remote_dep_dec_flying_messages``, ``remote_dep.h:367-372``).
+
+Writeback edges (``-> A(k)`` arrows whose home tile lives on another rank)
+ride the same activation with an ownerless descriptor; the home rank applies
+them to its local master copy.
+
+TPU-first note: on hardware the payload move is an ICI device-to-device
+transfer between HBM-resident tiles; the tree propagation maps onto neighbor
+chains of the ICI torus.  The in-process fabric exercises the identical
+protocol (SURVEY §5.8).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable
+
+import numpy as np
+
+from ..core.params import params as _params
+from ..data.data import data_create
+from ..runtime.scheduling import (ExecutionStream, _find_input_dep,
+                                  apply_writeback_to_home, schedule_tasks)
+from ..runtime.task import Task
+from .engine import (AM_TAG_ACTIVATE, AM_TAG_GET_ACK, CommEngine)
+
+_params.register("comm_short_limit", 4096,
+                 "payloads at most this many bytes ride inside the "
+                 "activation message (short-message inlining)")
+_params.register("comm_bcast_tree", "binomial",
+                 "multi-peer activation propagation: binomial|chain|star")
+
+
+# ---------------------------------------------------------------------------
+# propagation trees (cf. remote_dep.c:320-358) — positions are indices into
+# the sorted participant list, position 0 = root; children are re-derived
+# identically at every hop, so no child list rides the wire
+# ---------------------------------------------------------------------------
+
+def tree_children(kind: str, position: int, n: int) -> list[int]:
+    if n <= 1:
+        return []
+    if kind == "star":
+        return list(range(1, n)) if position == 0 else []
+    if kind == "chain":
+        return [position + 1] if position + 1 < n else []
+    # binomial: children of p are p + 2^j for 2^j > p
+    out = []
+    j = 1
+    while j <= position:
+        j <<= 1
+    while position + j < n:
+        out.append(position + j)
+        j <<= 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# producer-side accumulation
+# ---------------------------------------------------------------------------
+
+class _RemoteOutput:
+    __slots__ = ("flow_index", "copy", "ranks", "writeback_ranks")
+
+    def __init__(self, flow_index: int) -> None:
+        self.flow_index = flow_index
+        self.copy = None              # producing DataCopy (None for CTL)
+        self.ranks: set[int] = set()  # ranks with consumer successors
+        self.writeback_ranks: set[int] = set()  # remote home-tile writebacks
+
+
+class RemoteDeps:
+    """Per-completed-task record of which peers need which outputs."""
+
+    __slots__ = ("task", "outputs")
+
+    def __init__(self, task: Task) -> None:
+        self.task = task
+        self.outputs: dict[int, _RemoteOutput] = {}
+
+    def output(self, flow_index: int) -> _RemoteOutput:
+        o = self.outputs.get(flow_index)
+        if o is None:
+            o = self.outputs[flow_index] = _RemoteOutput(flow_index)
+        return o
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class RemoteDepEngine:
+    """Owns one rank's comm engine and implements the activation protocol.
+
+    Installed as ``context.comm_engine``; the context delegates
+    ``remote_dep_accumulate`` / ``remote_dep_activate`` here and calls
+    :meth:`progress` from idle workers (the reference funnels the same work
+    through its comm thread, ``remote_dep_mpi.c:426-484``).
+    """
+
+    def __init__(self, context: Any, ce: CommEngine) -> None:
+        self.ctx = context
+        self.ce = ce
+        context.comm_engine = self
+        self.my_rank = ce.rank
+        self.nranks = ce.nranks
+        self._es = ExecutionStream(-2, context.virtual_processes[0], context)
+        self._seq = itertools.count(1)
+        # activation seq -> (taskpool, parent_rank or None)
+        self._inflight: dict[int, Any] = {}
+        self._iflock = threading.Lock()
+        # activations whose taskpool comm-id is not registered yet
+        # (cf. DEP_NEW_TASKPOOL delays, remote_dep_mpi.c); guarded by a lock:
+        # appended from worker progress, replayed from the enqueuing thread
+        self._pending_unknown_tp: list[tuple[int, dict]] = []
+        self._pending_lock = threading.Lock()
+        ce.tag_register(AM_TAG_ACTIVATE, self._on_activate)
+        ce.tag_register(AM_TAG_GET_ACK, self._on_ack)
+
+    # ------------------------------------------------------------ lifecycle
+    def enable(self) -> None:
+        self.ce.enable()
+
+    def fini(self) -> None:
+        self.ce.fini()
+
+    def progress(self, es: Any = None) -> int:
+        return self.ce.progress()
+
+    def inflight(self) -> int:
+        with self._iflock:
+            return len(self._inflight)
+
+    def quiesce(self, timeout: float = 60.0) -> None:
+        """Progress until this rank has no in-flight activations and an
+        all-ranks barrier passes twice with silence in between (context-level
+        drain; taskpool-level termination is the termdet's job)."""
+        import time
+        deadline = time.monotonic() + timeout
+        for _round in range(2):
+            while self.inflight() or self.ce.pending():
+                self.ce.progress()
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"rank {self.my_rank} quiesce timeout")
+            self.ce.sync()
+
+    # ------------------------------------------------- producer (sender) side
+    def accumulate(self, remote: RemoteDeps | None, task: Task, flow, dep,
+                   succ_tc, succ_locals, rank: int) -> RemoteDeps:
+        """One remote successor edge found by release_deps (the remote branch
+        of ``parsec_release_dep_fct``, ``parsec.c:1808-1874``)."""
+        if remote is None:
+            remote = RemoteDeps(task)
+        out = remote.output(flow.flow_index)
+        if not flow.is_ctl:
+            out.copy = task.data[flow.flow_index]
+        if succ_tc is None:
+            out.writeback_ranks.add(rank)
+        else:
+            out.ranks.add(rank)
+        return remote
+
+    def activate(self, es: Any, task: Task, remote: RemoteDeps) -> None:
+        """Kick the sends (``parsec_remote_dep_activate``, ``remote_dep.c:441``).
+
+        Peers are grouped by identical output masks so true broadcasts share
+        one propagation tree; odd one-off masks fall back to direct sends.
+        """
+        tp = task.taskpool
+        by_mask: dict[tuple, list[int]] = {}
+        all_ranks: dict[int, set[int]] = {}
+        for fi, out in remote.outputs.items():
+            for r in out.ranks | out.writeback_ranks:
+                all_ranks.setdefault(r, set()).add(fi)
+        for r, flows in all_ranks.items():
+            by_mask.setdefault(tuple(sorted(flows)), []).append(r)
+
+        for flows, ranks in by_mask.items():
+            ranks.sort()
+            outputs = []
+            for fi in flows:
+                out = remote.outputs[fi]
+                desc = {"flow_index": fi,
+                        "writeback": bool(out.writeback_ranks)}
+                if out.copy is not None:
+                    value = np.asarray(out.copy.value)
+                    desc["version"] = out.copy.version
+                    if value.nbytes <= _params.get("comm_short_limit"):
+                        # receiver must own its bytes even in-process
+                        desc["inline"] = value.copy()
+                    else:
+                        nchildren = len(tree_children(
+                            _params.get("comm_bcast_tree"), 0,
+                            len(ranks) + 1))
+                        h = self.ce.mem_register(value, refcount=nchildren)
+                        desc["wire"] = h.wire()
+                        desc["shape"] = value.shape
+                        desc["dtype"] = str(value.dtype)
+                outputs.append(desc)
+            msg = {
+                "tp": tp.comm_id,
+                "tc": task.task_class.task_class_id,
+                "locals": dict(task.locals),
+                "outputs": outputs,
+                # participants: producer at position 0, consumers after —
+                # every hop re-derives its children from this list
+                "ranks": [self.my_rank] + ranks,
+                "tree": _params.get("comm_bcast_tree"),
+                "priority": task.priority,
+            }
+            self._send_to_children(tp, msg, my_pos=0)
+
+    def _send_to_children(self, tp: Any, msg: dict, my_pos: int) -> None:
+        ranks = msg["ranks"]
+        for child_pos in tree_children(msg["tree"], my_pos, len(ranks)):
+            seq = next(self._seq)
+            with self._iflock:
+                self._inflight[seq] = tp
+            # in-flight activation == pending action on the termdet
+            # (remote_dep.h:360-372)
+            tp.tdm.taskpool_addto_nb_pa(+1)
+            child_msg = dict(msg)
+            child_msg["seq"] = seq
+            child_msg["pos"] = child_pos
+            self.ce.send_am(AM_TAG_ACTIVATE, ranks[child_pos], child_msg)
+
+    def _on_ack(self, eng, src: int, msg: dict) -> None:
+        with self._iflock:
+            tp = self._inflight.pop(msg["seq"])
+        tp.tdm.taskpool_addto_nb_pa(-1)
+
+    # ------------------------------------------------- consumer (receiver) side
+    def taskpool_registered(self, tp: Any) -> None:
+        """Replay activations that raced ahead of the taskpool's enqueue."""
+        with self._pending_lock:
+            replay = [m for m in self._pending_unknown_tp
+                      if m[1]["tp"] == tp.comm_id]
+            self._pending_unknown_tp = [
+                m for m in self._pending_unknown_tp
+                if m[1]["tp"] != tp.comm_id]
+        for src, msg in replay:
+            self._on_activate(self.ce, src, msg)
+
+    def _on_activate(self, eng, src: int, msg: dict) -> None:
+        tp = self.ctx._tp_by_comm_id.get(msg["tp"])
+        if tp is None:
+            with self._pending_lock:
+                # re-check under the lock: registration may have just landed
+                tp = self.ctx._tp_by_comm_id.get(msg["tp"])
+                if tp is None:
+                    self._pending_unknown_tp.append((src, msg))
+                    return
+        want = [d for d in msg["outputs"] if "wire" in d]
+        # every receiver owns its bytes: an inline payload forwarded down the
+        # tree would otherwise alias across ranks
+        landed: dict[int, Any] = {
+            d["flow_index"]: (d["inline"].copy()
+                              if isinstance(d["inline"], np.ndarray)
+                              else d["inline"])
+            for d in msg["outputs"] if "inline" in d}
+        if not want:
+            self._complete_incoming(tp, src, msg, landed)
+            return
+        remaining = [len(want)]
+
+        def make_cb(d):
+            def cb(value):
+                landed[d["flow_index"]] = value
+                remaining[0] -= 1
+                if remaining[0] == 0:
+                    self._complete_incoming(tp, src, msg, landed)
+            return cb
+
+        for d in want:
+            self.ce.get(tuple(d["wire"]), make_cb(d))
+
+    def _complete_incoming(self, tp: Any, src: int, msg: dict,
+                           landed: dict[int, Any]) -> None:
+        """All payloads present: release local successors, apply writebacks,
+        forward down the tree, ack the parent."""
+        tc = tp.task_classes[msg["tc"]]
+        ghost = Task(tp, tc, dict(msg["locals"]),
+                     priority=msg.get("priority", 0))
+        copies = {}
+        for d in msg["outputs"]:
+            fi = d["flow_index"]
+            if fi in landed:
+                datum = data_create(
+                    landed[fi], key=("remote", tp.comm_id, tc.name,
+                                     tuple(sorted(msg["locals"].items())), fi))
+                copy = datum.get_copy(0)
+                copy.version = d.get("version", 1)
+                copies[fi] = copy
+                ghost.data[fi] = copy
+
+        ready: list[Task] = []
+        out_mask = {d["flow_index"] for d in msg["outputs"]}
+        wb = {d["flow_index"]: d.get("writeback", False)
+              for d in msg["outputs"]}
+
+        def visitor(t: Task, flow, dep) -> None:
+            if flow.flow_index not in out_mask:
+                return
+            if dep.target_class is None:
+                # apply only on the tile's home rank: other ranks sharing
+                # this activation's mask must not fabricate master copies
+                if wb.get(flow.flow_index) and dep.data_ref is not None:
+                    copy = copies.get(flow.flow_index)
+                    dc, key = dep.data_ref(t.locals)
+                    if copy is not None and dc.rank_of(*key) == self.my_rank:
+                        apply_writeback_to_home(dc, key, copy)
+                return
+            succ_tc = tp.task_class(dep.target_class)
+            succ_locals = dep.target_params(t.locals)
+            rank = self._succ_rank(succ_tc, succ_locals)
+            if rank != self.my_rank:
+                return
+            fi, di = _find_input_dep(succ_tc, dep.target_flow, tc.name,
+                                     succ_locals)
+            rt = self.ctx.deps.release_dep(tp, succ_tc, succ_locals, fi, di,
+                                           copies.get(flow.flow_index), None)
+            if rt is not None:
+                ready.append(rt)
+
+        tc.iterate_successors(ghost, visitor)
+
+        # interior tree node: re-register landed buffers and forward
+        # (parsec_remote_dep_propagate, remote_dep.c:409-436)
+        my_pos = msg["pos"]
+        children = tree_children(msg["tree"], my_pos, len(msg["ranks"]))
+        if children:
+            fwd = dict(msg)
+            fwd["outputs"] = [dict(d) for d in msg["outputs"]]
+            for d in fwd["outputs"]:
+                if "wire" in d:
+                    value = np.asarray(landed[d["flow_index"]])
+                    h = self.ce.mem_register(value, refcount=len(children))
+                    d["wire"] = h.wire()
+            self._send_to_children(tp, fwd, my_pos=my_pos)
+
+        self.ce.send_am(AM_TAG_GET_ACK, src, {"seq": msg["seq"]})
+        if ready:
+            schedule_tasks(self._es, ready, 0)
+
+    def _succ_rank(self, tc, locals_) -> int:
+        if tc.affinity is None:
+            return self.my_rank
+        dc, key = tc.affinity(locals_)
+        if not isinstance(key, tuple):
+            key = (key,)
+        return dc.rank_of(*key)

@@ -57,6 +57,10 @@ class Context:
         self.deps = DependencyTracking()
         self.taskpool_list: list[Taskpool] = []
         self.comm_engine: Any = None
+        # rank-agreed taskpool ids for the wire protocol: ranks enqueue
+        # taskpools in the same order, so the per-context sequence agrees
+        # (the parsec_taskpool_reserve_id / sync_ids analog, parsec.c:2038)
+        self._tp_by_comm_id: dict[int, Taskpool] = {}
         self._worker_error: BaseException | None = None
 
         # devices: registry is process-global; the context snapshots it
@@ -109,6 +113,8 @@ class Context:
         with self._lock:
             self._active_taskpools.append(tp)
             self.taskpool_list.append(tp)
+            tp.comm_id = len(self.taskpool_list)
+            self._tp_by_comm_id[tp.comm_id] = tp
         if tp.on_enqueue is not None:
             tp.on_enqueue(tp)
         n = tp.nb_local_tasks()
@@ -116,6 +122,8 @@ class Context:
             tp.tdm.taskpool_addto_nb_tasks(n)
         startup = tp.startup(self)
         tp.tdm.ready()
+        if self.comm_engine is not None:
+            self.comm_engine.taskpool_registered(tp)
         if startup:
             schedule_tasks(self._submit_es, list(startup), 0)
 
@@ -240,10 +248,24 @@ class Context:
                 self._active_taskpools.remove(tp)
             self._cond.notify_all()
 
-    # remote-dep seams; the comm layer replaces these (SURVEY §3.4)
+    def comm_barrier(self) -> None:
+        """Collective fence: progress until the fabric is globally silent.
+
+        Required before reading data written by a *remote* rank's writeback
+        edge — local taskpool termination only covers local tasks plus this
+        rank's own in-flight sends (the one-sided-semantics fence)."""
+        if self.comm_engine is not None:
+            self.comm_engine.quiesce()
+
+    # remote-dep seams, delegated to the comm layer (SURVEY §3.4)
     def remote_dep_accumulate(self, remote, task, flow, dep, succ_tc,
                               succ_locals, rank):
-        raise RuntimeError("remote successor but no comm engine installed")
+        if self.comm_engine is None:
+            raise RuntimeError("remote successor but no comm engine installed")
+        return self.comm_engine.accumulate(remote, task, flow, dep, succ_tc,
+                                           succ_locals, rank)
 
     def remote_dep_activate(self, es, task, remote) -> None:
-        raise RuntimeError("remote deps but no comm engine installed")
+        if self.comm_engine is None:
+            raise RuntimeError("remote deps but no comm engine installed")
+        self.comm_engine.activate(es, task, remote)

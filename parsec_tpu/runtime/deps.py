@@ -47,7 +47,7 @@ class DependencyTracking:
         ``repo_ref`` is (repo_entry, src_flow_index) for usage accounting at
         completion (``jdf2c.c:7157`` consume-input-repos contract).
         """
-        key = (tc.task_class_id, tc.make_key(locals_))
+        key = (taskpool.taskpool_id, tc.task_class_id, tc.make_key(locals_))
         bit = 1 << tc.dep_bit(flow_index, dep_index)
         with self._table.locked(key):
             trk = self._table.get(key)

@@ -234,6 +234,13 @@ def release_deps(es: ExecutionStream, task: Task) -> None:
         nonlocal entry, nconsumers, remote
         out_copy = None if flow.is_ctl else t.data[flow.flow_index]
         if dep.target_class is None:
+            home_rank = _rank_of_data(ctx, dep, t.locals)
+            if home_rank is not None and home_rank != ctx.my_rank:
+                # home tile lives on another rank: ship the final version
+                # (the remote write-back path of parsec_release_dep_fct)
+                remote = ctx.remote_dep_accumulate(remote, t, flow, dep,
+                                                   None, None, home_rank)
+                return
             _writeback(t, flow, dep, out_copy)
             return
         succ_tc = tp.task_class(dep.target_class)
@@ -271,8 +278,14 @@ def _writeback(task: Task, flow, dep, out_copy) -> None:
     if out_copy is None or dep.data_ref is None:
         return
     dc, key = dep.data_ref(task.locals)
+    apply_writeback_to_home(dc, key, out_copy)
+
+
+def apply_writeback_to_home(dc, key: tuple, out_copy) -> None:
+    """Apply a final version to a collection's home (device-0) copy — shared
+    by the local release path and the remote-dep receiver."""
     datum = dc.data_of(*key)
-    home = datum.get_copy(0)
+    home = datum.get_copy(0)  # collections create the host copy eagerly
     if home is None or home is out_copy:
         return
     home.value = out_copy.value
@@ -283,4 +296,13 @@ def _rank_of_task(ctx, tc: TaskClass, locals_: dict):
     if ctx.nb_ranks <= 1 or tc.affinity is None:
         return None
     dc, key = tc.affinity(locals_)
+    if not isinstance(key, tuple):
+        key = (key,)
+    return dc.rank_of(*key)
+
+
+def _rank_of_data(ctx, dep, locals_: dict):
+    if ctx.nb_ranks <= 1 or dep.data_ref is None:
+        return None
+    dc, key = dep.data_ref(locals_)
     return dc.rank_of(*key)

@@ -1,0 +1,159 @@
+"""Multi-rank protocol tests: chains, broadcast trees, writebacks.
+
+The analog of the reference's distributed test tier (SURVEY §4: shm + MPI
+``-np 2/4/8`` variants of the DSL tests; ``examples/Ex03_ChainMPI.jdf``,
+``Ex05_Broadcast``): the in-process fabric exercises the full activation /
+rendezvous-GET / propagation-tree / termdet-pending-action protocol.
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import ptg
+from parsec_tpu.comm import run_multirank
+from parsec_tpu.comm.remote_dep import tree_children
+from parsec_tpu.core.params import params
+from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
+
+
+# ---------------------------------------------------------------------------
+# tree unit tests
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["binomial", "chain", "star"])
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 13])
+def test_tree_covers_every_node_once(kind, n):
+    seen = {0}
+    frontier = [0]
+    while frontier:
+        nxt = []
+        for p in frontier:
+            for c in tree_children(kind, p, n):
+                assert c not in seen, f"{kind} n={n}: node {c} visited twice"
+                seen.add(c)
+                nxt.append(c)
+        frontier = nxt
+    assert seen == set(range(n)), f"{kind} n={n}: missing {set(range(n)) - seen}"
+
+
+# ---------------------------------------------------------------------------
+# PTG builders shared by the rank bodies
+# ---------------------------------------------------------------------------
+
+def _chain_tp(V, nt: int):
+    """T(0) reads V(0); T(i) -> T(i+1) crosses ranks; T(nt-1) writes V(0)
+    (a remote writeback for every rank layout with nranks > 1)."""
+    p = ptg.PTGBuilder("chain", V=V, NT=nt)
+    t = p.task("T", i=ptg.span(0, lambda g, l: g.NT - 1))
+    t.affinity("V", lambda g, l: (l.i,))
+    f = t.flow("A", ptg.RW)
+    f.input(data=("V", lambda g, l: (0,)), guard=lambda g, l: l.i == 0)
+    f.input(pred=("T", "A", lambda g, l: {"i": l.i - 1}),
+            guard=lambda g, l: l.i > 0)
+    f.output(succ=("T", "A", lambda g, l: {"i": l.i + 1}),
+             guard=lambda g, l: l.i < g.NT - 1)
+    f.output(data=("V", lambda g, l: (0,)),
+             guard=lambda g, l: l.i == g.NT - 1)
+
+    def body(es, task, g, l):
+        task.flow_data("A").value[...] += 1.0
+
+    t.body(body)
+    return p.build()
+
+
+def _chain_body(ctx, rank, nranks):
+    nt = 7
+    V = VectorTwoDimCyclic("V", lm=nt * 4, mb=4, P=nranks, myrank=rank,
+                           init_fn=lambda m, size: np.zeros(size))
+    tp = _chain_tp(V, nt)
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=60)
+    # local termination != global: fence before reading the remote writeback
+    ctx.comm_barrier()
+    if rank == 0:  # home of V(0): the writeback target
+        return np.asarray(V.data_of(0).newest_copy().value).copy()
+    return None
+
+
+@pytest.mark.parametrize("nranks", [2, 4])
+def test_chain_across_ranks(nranks):
+    """Ex03 shape: a value threads through every rank, +1 per hop, and the
+    final version writes back to rank 0's home tile."""
+    res = run_multirank(nranks, _chain_body)
+    np.testing.assert_allclose(res[0], np.full(4, 7.0))
+
+
+def _bcast_tp(V, nranks: int, payload: int):
+    p = ptg.PTGBuilder("bcast", V=V, NR=nranks, PAY=payload)
+    w = p.task("W", z=ptg.span(0, 0))
+    w.affinity("V", lambda g, l: (0,))
+    fw = w.flow("A", ptg.WRITE,
+                dtt=None)
+    for r in range(nranks):
+        fw.output(succ=("R", "X", lambda g, l, r=r: {"r": r}))
+
+    def wbody(es, task, g, l):
+        from parsec_tpu.data.data import data_create
+        arr = np.arange(g.PAY, dtype=np.float32)
+        task.set_flow_data("A", data_create(arr, key=("w", 0)).get_copy(0))
+
+    w.body(wbody)
+
+    t = p.task("R", r=ptg.span(0, lambda g, l: g.NR - 1))
+    t.affinity("V", lambda g, l: (l.r,))
+    fx = t.flow("X", ptg.READ)
+    fx.input(pred=("W", "A", lambda g, l: {"z": 0}))
+    fy = t.flow("Y", ptg.RW)
+    fy.input(data=("V", lambda g, l: (l.r,)))
+    fy.output(data=("V", lambda g, l: (l.r,)))
+
+    def rbody(es, task, g, l):
+        task.flow_data("Y").value[...] = float(task.flow_data("X").value.sum())
+
+    t.body(rbody)
+    return p.build()
+
+
+def _mk_bcast_body(payload):
+    def body(ctx, rank, nranks):
+        V = VectorTwoDimCyclic("V", lm=nranks, mb=1, P=nranks, myrank=rank,
+                               init_fn=lambda m, size: np.zeros(size))
+        tp = _bcast_tp(V, nranks, payload)
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+        return float(np.asarray(V.data_of(rank).newest_copy().value)[0])
+    return body
+
+
+@pytest.mark.parametrize("nranks,tree", [(2, "binomial"), (4, "binomial"),
+                                         (4, "chain"), (4, "star")])
+def test_broadcast_inline(nranks, tree):
+    """Ex05 shape with a short payload riding inside the activation."""
+    params.set("comm_bcast_tree", tree)
+    try:
+        res = run_multirank(nranks, _mk_bcast_body(8))
+    finally:
+        params.set("comm_bcast_tree", "binomial")
+    expect = float(np.arange(8, dtype=np.float32).sum())
+    assert res == [expect] * nranks
+
+
+@pytest.mark.parametrize("nranks", [4])
+def test_broadcast_rendezvous_get(nranks):
+    """Payload above comm_short_limit: moves by registered-memory GET and is
+    re-registered at every interior tree node."""
+    old = params.get("comm_short_limit")
+    params.set("comm_short_limit", 64)
+    try:
+        res = run_multirank(nranks, _mk_bcast_body(4096))
+    finally:
+        params.set("comm_short_limit", old)
+    expect = float(np.arange(4096, dtype=np.float32).sum())
+    assert res == [expect] * nranks
+
+
+def test_single_rank_unaffected():
+    """nb_ranks=1 contexts never touch the comm seams."""
+    res = run_multirank(1, _chain_body)
+    np.testing.assert_allclose(res[0], np.full(4, 7.0))
