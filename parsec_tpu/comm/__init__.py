@@ -20,9 +20,10 @@ from .engine import (AM_TAG_ACTIVATE, AM_TAG_GET_ACK, AM_TAG_TERMDET,
                      CommEngine, InprocFabric, MemHandle)
 from .remote_dep import RemoteDepEngine, RemoteDeps
 from .multirank import run_multirank
+from .termdet_fourcounter import FourCounterTermDet  # registers the component
 
 __all__ = [
     "CommEngine", "InprocFabric", "MemHandle", "RemoteDepEngine",
-    "RemoteDeps", "run_multirank", "AM_TAG_ACTIVATE", "AM_TAG_GET_ACK",
-    "AM_TAG_TERMDET",
+    "RemoteDeps", "FourCounterTermDet", "run_multirank", "AM_TAG_ACTIVATE",
+    "AM_TAG_GET_ACK", "AM_TAG_TERMDET",
 ]

@@ -45,7 +45,8 @@ from ..data.data import data_create
 from ..runtime.scheduling import (ExecutionStream, _find_input_dep,
                                   apply_writeback_to_home, schedule_tasks)
 from ..runtime.task import Task
-from .engine import (AM_TAG_ACTIVATE, AM_TAG_GET_ACK, CommEngine)
+from .engine import (AM_TAG_ACTIVATE, AM_TAG_GET_ACK, AM_TAG_TERMDET,
+                     CommEngine)
 
 _params.register("comm_short_limit", 4096,
                  "payloads at most this many bytes ride inside the "
@@ -137,8 +138,12 @@ class RemoteDepEngine:
         # appended from worker progress, replayed from the enqueuing thread
         self._pending_unknown_tp: list[tuple[int, dict]] = []
         self._pending_lock = threading.Lock()
+        # distributed termdet monitors by taskpool comm-id, + stashed tokens
+        self._termdet: dict[int, Any] = {}
+        self._pending_termdet: list[dict] = []
         ce.tag_register(AM_TAG_ACTIVATE, self._on_activate)
         ce.tag_register(AM_TAG_GET_ACK, self._on_ack)
+        ce.tag_register(AM_TAG_TERMDET, self._on_termdet)
 
     # ------------------------------------------------------------ lifecycle
     def enable(self) -> None:
@@ -240,8 +245,9 @@ class RemoteDepEngine:
             with self._iflock:
                 self._inflight[seq] = tp
             # in-flight activation == pending action on the termdet
-            # (remote_dep.h:360-372)
+            # (remote_dep.h:360-372); fourcounter also counts raw messages
             tp.tdm.taskpool_addto_nb_pa(+1)
+            tp.tdm.on_comm_sent()
             child_msg = dict(msg)
             child_msg["seq"] = seq
             child_msg["pos"] = child_pos
@@ -253,14 +259,49 @@ class RemoteDepEngine:
         tp.tdm.taskpool_addto_nb_pa(-1)
 
     # ------------------------------------------------- consumer (receiver) side
+    # --------------------------------------------------- distributed termdet
+    def send_termdet(self, dst: int, token: dict) -> None:
+        """Ship a termination-detection token (reserved tag, §2.4/§2.6)."""
+        self.ce.send_am(AM_TAG_TERMDET, dst, token)
+
+    def _on_termdet(self, eng, src: int, token: dict) -> None:
+        mon = self._termdet.get(token["tp"])
+        if mon is None:
+            tp = self.ctx._tp_by_comm_id.get(token["tp"])
+            if tp is not None:
+                raise RuntimeError(
+                    f"rank {self.my_rank}: termdet wave token for taskpool "
+                    f"{tp.name} whose detector ({tp.tdm.name}) is not "
+                    f"distributed — termdet selection differs across ranks")
+            with self._pending_lock:
+                mon = self._termdet.get(token["tp"])
+                if mon is None:
+                    self._pending_termdet.append(token)
+                    return
+        mon.on_token(token)
+
     def taskpool_registered(self, tp: Any) -> None:
-        """Replay activations that raced ahead of the taskpool's enqueue."""
+        """Replay activations/tokens that raced ahead of the enqueue."""
+        distributed = hasattr(tp.tdm, "on_token")
         with self._pending_lock:
+            if distributed:
+                self._termdet[tp.comm_id] = tp.tdm
+            replay_td = [t for t in self._pending_termdet
+                         if t["tp"] == tp.comm_id]
+            self._pending_termdet = [
+                t for t in self._pending_termdet if t["tp"] != tp.comm_id]
             replay = [m for m in self._pending_unknown_tp
                       if m[1]["tp"] == tp.comm_id]
             self._pending_unknown_tp = [
                 m for m in self._pending_unknown_tp
                 if m[1]["tp"] != tp.comm_id]
+        if replay_td and not distributed:
+            raise RuntimeError(
+                f"rank {self.my_rank}: received termdet wave tokens for "
+                f"taskpool {tp.name} whose detector ({tp.tdm.name}) is not "
+                f"distributed — termdet selection differs across ranks")
+        for token in replay_td:
+            tp.tdm.on_token(token)
         for src, msg in replay:
             self._on_activate(self.ce, src, msg)
 
@@ -301,6 +342,7 @@ class RemoteDepEngine:
                            landed: dict[int, Any]) -> None:
         """All payloads present: release local successors, apply writebacks,
         forward down the tree, ack the parent."""
+        tp.tdm.on_comm_recv()
         tc = tp.task_classes[msg["tc"]]
         ghost = Task(tp, tc, dict(msg["locals"]),
                      priority=msg.get("priority", 0))

@@ -1,0 +1,196 @@
+"""Fourcounter: distributed termination detection by counting waves.
+
+Rebuild of ``parsec/mca/termdet/fourcounter`` (SURVEY §2.4): local counters
+alone cannot terminate a distributed taskpool — a rank with zero remaining
+local tasks may still have a message in flight toward it.  The fourcounter
+scheme (Mattern's four-counter / double-wave method) circulates a control
+token around the rank ring accumulating
+
+- ``S`` — total dependency-activation messages *sent* by all ranks,
+- ``R`` — total activation messages *received* (counted at delivery),
+- ``idle`` — every rank locally idle (nb_tasks == nb_pending_actions == 0).
+
+Rank 0 concludes termination when a wave returns fully idle with ``S == R``
+**and** the pair matches the previous wave (no traffic moved between two
+consecutive global snapshots); it then sends a TERMINATE token around the
+ring and every rank fires its taskpool's termination callback.  A rank that
+is busy when the token arrives holds it until it goes idle
+(``termdet_fourcounter_module.c``'s deferred wave participation).
+
+The token rides the reserved :data:`~parsec_tpu.comm.engine.AM_TAG_TERMDET`
+tag (cf. the reference reserving a comm-engine tag for its waves,
+``parsec_comm_engine.h:35``).  Rendezvous-GET acknowledgements need no
+counting: the sender holds a pending action until the consumer acks, so
+unfinished transfers keep their sender busy and block the wave.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from ..core.mca import Component, component
+from ..runtime.termdet import (STATE_BUSY, STATE_IDLE, STATE_TERMINATED,
+                               TermDetMonitor)
+
+
+class FourCounterTermDet(TermDetMonitor):
+    """Per-taskpool monitor; one instance per rank, linked over the ring."""
+
+    name = "fourcounter"
+
+    def __init__(self, context: Any) -> None:
+        super().__init__()
+        self.ctx = context
+        self.msgs_sent = 0
+        self.msgs_recv = 0
+        self._held_tokens: list[dict] = []
+        self._kick_wave = False
+        # rank 0 only: previous wave snapshot + single-outstanding-wave flag
+        # (overlapping waves would break the consecutive-snapshot premise)
+        self._prev_wave: tuple[int, int] | None = None
+        self._wave_out = False
+
+    # -- engine plumbing ------------------------------------------------------
+    @property
+    def _engine(self):
+        return self.ctx.comm_engine
+
+    def _comm_id(self) -> int:
+        return self.taskpool.comm_id
+
+    def on_comm_sent(self) -> None:
+        with self._lock:
+            self.msgs_sent += 1
+
+    def on_comm_recv(self) -> None:
+        with self._lock:
+            self.msgs_recv += 1
+
+    # -- state machine --------------------------------------------------------
+    # the base-class mutators call _check_idle_locked and _terminate on True;
+    # here going idle never terminates directly — it releases a wave instead
+    def _check_idle_locked(self) -> bool:
+        if self.ctx is None or self.ctx.nb_ranks <= 1:
+            return super()._check_idle_locked()
+        if (self.state == STATE_BUSY and self.nb_tasks == 0
+                and self.nb_pending_actions == 0):
+            self.state = STATE_IDLE
+            self._kick_wave = True
+        elif self.state == STATE_IDLE and (self.nb_tasks > 0
+                                           or self.nb_pending_actions > 0):
+            self.state = STATE_BUSY
+        return False
+
+    # hook into the mutators' unlock point: the base class only calls
+    # _terminate() when _check_idle_locked returned True, so we piggyback on
+    # the public mutators to flush wave work after the lock drops
+    def taskpool_addto_nb_tasks(self, delta: int) -> int:
+        n = super().taskpool_addto_nb_tasks(delta)
+        self._flush_wave_work()
+        return n
+
+    def taskpool_addto_nb_pa(self, delta: int) -> int:
+        n = super().taskpool_addto_nb_pa(delta)
+        self._flush_wave_work()
+        return n
+
+    def ready(self) -> None:
+        super().ready()
+        self._flush_wave_work()
+
+    def _flush_wave_work(self) -> None:
+        if self.ctx is None or self.ctx.nb_ranks <= 1:
+            return
+        if not self._kick_wave:  # unlocked fast path: flag set under the
+            return               # same lock by the mutator that just ran
+        tokens: list[dict] = []
+        start = False
+        with self._lock:
+            if self.state != STATE_IDLE or not self._kick_wave:
+                return
+            self._kick_wave = False
+            if self._held_tokens:
+                tokens, self._held_tokens = self._held_tokens, []
+            elif self.ctx.my_rank == 0 and not self._wave_out:
+                self._wave_out = True
+                start = True
+        for token in tokens:
+            self._contribute_and_forward(token)
+        if start:
+            self._start_wave()
+
+    # -- waves ----------------------------------------------------------------
+    def _start_wave(self) -> None:
+        self._contribute_and_forward({
+            "tp": self._comm_id(), "kind": "wave", "S": 0, "R": 0,
+            "idle": True,
+        })
+
+    def _contribute_and_forward(self, token: dict) -> None:
+        with self._lock:
+            token["S"] += self.msgs_sent
+            token["R"] += self.msgs_recv
+            token["idle"] = token["idle"] and self.state == STATE_IDLE
+        nxt = (self.ctx.my_rank + 1) % self.ctx.nb_ranks
+        self._engine.send_termdet(nxt, token)
+
+    def on_token(self, token: dict) -> None:
+        """A wave or terminate token arrived for this taskpool."""
+        if token["kind"] == "term":
+            self._ring_terminate(forward=True)
+            return
+        if self.ctx.my_rank == 0:
+            self._conclude_wave(token)
+            return
+        with self._lock:
+            if self.state != STATE_IDLE:
+                # busy: hold the token until the local counters drain
+                self._held_tokens.append(token)
+                return
+        self._contribute_and_forward(token)
+
+    def _conclude_wave(self, token: dict) -> None:
+        with self._lock:
+            self._wave_out = False
+            my_idle = self.state == STATE_IDLE
+        snap = (token["S"], token["R"])
+        if (token["idle"] and my_idle and token["S"] == token["R"]
+                and self._prev_wave == snap):
+            self._ring_terminate(forward=True)
+            return
+        self._prev_wave = snap
+        with self._lock:
+            if my_idle and not self._wave_out:
+                self._wave_out = True
+            else:
+                # re-kick when we next go idle
+                self._kick_wave = True
+                return
+        self._start_wave()
+
+    def _ring_terminate(self, forward: bool) -> None:
+        nxt = (self.ctx.my_rank + 1) % self.ctx.nb_ranks
+        if forward and nxt != 0:
+            self._engine.send_termdet(
+                nxt, {"tp": self._comm_id(), "kind": "term"})
+        fire = False
+        with self._lock:
+            if self.state != STATE_TERMINATED:
+                self.state = STATE_TERMINATED
+                fire = True
+        if fire:
+            self._terminate()
+
+
+@component
+class FourCounterComponent(Component):
+    type_name = "termdet"
+    name = "fourcounter"
+    priority = 10
+
+    def query(self, context: Any = None) -> bool:
+        return False  # only by explicit request (--mca termdet fourcounter)
+
+    def open(self, context: Any = None) -> FourCounterTermDet:
+        return FourCounterTermDet(context)

@@ -80,6 +80,13 @@ class TermDetMonitor:
             return True
         return False
 
+    # comm-message counters: no-ops except for distributed detectors
+    def on_comm_sent(self) -> None:
+        pass
+
+    def on_comm_recv(self) -> None:
+        pass
+
     def _terminate(self) -> None:
         if self._on_terminated is not None:
             self._on_terminated()

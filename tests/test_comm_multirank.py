@@ -157,3 +157,43 @@ def test_single_rank_unaffected():
     """nb_ranks=1 contexts never touch the comm seams."""
     res = run_multirank(1, _chain_body)
     np.testing.assert_allclose(res[0], np.full(4, 7.0))
+
+
+# ---------------------------------------------------------------------------
+# fourcounter distributed termination detection
+# ---------------------------------------------------------------------------
+
+def _chain_body_fourcounter(ctx, rank, nranks):
+    """Reads the remote writeback right after wait() with NO explicit fence:
+    only global (wave-based) termination makes that correct — the local
+    detector would release rank 0 before the final writeback lands."""
+    nt = 7
+    V = VectorTwoDimCyclic("V", lm=nt * 4, mb=4, P=nranks, myrank=rank,
+                           init_fn=lambda m, size: np.zeros(size))
+    tp = _chain_tp(V, nt)
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=60)
+    if rank == 0:
+        return np.asarray(V.data_of(0).newest_copy().value).copy()
+    return None
+
+
+@pytest.mark.parametrize("nranks", [2, 4, 8])
+def test_fourcounter_global_termination(nranks):
+    params.set("termdet", "fourcounter")
+    try:
+        res = run_multirank(nranks, _chain_body_fourcounter)
+    finally:
+        params.set("termdet", "")
+    np.testing.assert_allclose(res[0], np.full(4, 7.0))
+
+
+@pytest.mark.parametrize("nranks", [4])
+def test_fourcounter_broadcast(nranks):
+    params.set("termdet", "fourcounter")
+    try:
+        res = run_multirank(nranks, _mk_bcast_body(8))
+    finally:
+        params.set("termdet", "")
+    expect = float(np.arange(8, dtype=np.float32).sum())
+    assert res == [expect] * nranks
