@@ -2,6 +2,8 @@
 
 from .dsl import (CTL, READ, RW, WRITE, FlowBuilder, PTGBuilder, PTGTaskpool,
                   TaskClassBuilder, span)
+from .jdf import JDF, JDFError, parse_jdf
 
 __all__ = ["CTL", "READ", "RW", "WRITE", "FlowBuilder", "PTGBuilder",
-           "PTGTaskpool", "TaskClassBuilder", "span"]
+           "PTGTaskpool", "TaskClassBuilder", "span", "JDF", "JDFError",
+           "parse_jdf"]

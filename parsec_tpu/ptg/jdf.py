@@ -1,0 +1,598 @@
+"""JDF: the textual PTG front-end.
+
+Rebuild of the reference's JDF compiler (``parsec/interfaces/ptg/ptg-compiler``,
+SURVEY §2.7) as a parser into :class:`~parsec_tpu.ptg.dsl.PTGBuilder` — both
+front-ends share one backend, mirroring ``parsec_ptgpp`` emitting code against
+one runtime ABI.  Where the reference lexes C expressions (``parsec.l``) and
+generates C (``jdf2c.c``), this front-end compiles *Python* expressions and
+bodies — the idiomatic host language here — while keeping the JDF structure:
+
+Comments: ``/* block */`` and *full-line* ``//`` outside BODY/prologue
+regions only — trailing ``// …`` after code is not a comment because ``//``
+is Python floor division inside expressions; bodies use Python ``#``.
+
+.. code-block:: none
+
+    /* comments, and full-line // comments */
+    %{
+    # python prologue: names defined here are visible to every
+    # expression and body
+    %}
+
+    NT    [type = int]          /* scalar global, bound at build()    */
+    V     [type = data]         /* data-collection global             */
+
+    T(i)                        /* task class + parameters            */
+      i = 0 .. NT-1             /* execution-space range (inclusive)  */
+      : V(i)                    /* data affinity -> owning rank       */
+      RW A <- (i == 0) ? V(0) : A T(i-1)     /* guarded input arrows  */
+           -> (i <  NT-1) ? A T(i+1)         /* guarded output arrows */
+           -> (i == NT-1) ? V(0)
+      ; NT - i                  /* priority expression                */
+    BODY
+      A += 1       # python body: flow names bound to the tile arrays
+    END
+    BODY [type = tpu  dyld = gemm]
+    END
+
+Grammar notes (vs ``parsec.y``): execution-space ranges are ``lo .. hi`` or
+``lo .. hi .. step``; arrow targets are ``FLOW Class(args)`` (task dep) or
+``DataGlobal(args)`` (collection read/write-back); guards are
+``(expr) ? target`` or ``(expr) ? target : target``.  Dep ``[type=...]``
+reshape properties and ``NEW``/``NULL`` targets are not implemented yet.
+
+Sanity checking mirrors ``jdf_sanity_checks`` (``jdf.h:68-86``): unknown
+target classes/flows/collections, missing ranges, CTL flows with data
+targets, and malformed arrows all raise :class:`JDFError` at parse or build
+time — exercised by the must-fail suite (the ``ptgpp`` error-case tests,
+SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable
+
+from .dsl import CTL, READ, RW, WRITE, PTGBuilder, PTGTaskpool
+
+_ACCESS = {"RW": RW, "READ": READ, "WRITE": WRITE, "CTL": CTL}
+
+
+class JDFError(ValueError):
+    """Parse-time or build-time JDF rejection (sanity-check failure)."""
+
+
+# ---------------------------------------------------------------------------
+# lexical helpers
+# ---------------------------------------------------------------------------
+
+_RE_BODY_KW = re.compile(r"\s*BODY(\s|\[|$)")
+
+
+def _strip_comments(text: str) -> str:
+    """Remove ``/* */`` blocks and full-line ``//`` comments — but never
+    inside BODY…END regions, whose content is Python (where ``//`` is floor
+    division and ``#`` comments naturally).  Trailing ``// …`` after code is
+    deliberately NOT a comment for the same reason."""
+    out: list[str] = []
+    in_body = False
+    in_block = False
+    for line in text.split("\n"):
+        if in_body:
+            out.append(line)
+            if line.strip() == "END":
+                in_body = False
+            continue
+        kept: list[str] = []
+        j = 0
+        while j < len(line):
+            if in_block:
+                end = line.find("*/", j)
+                if end < 0:
+                    j = len(line)
+                else:
+                    in_block = False
+                    j = end + 2
+                continue
+            start = line.find("/*", j)
+            if start < 0:
+                kept.append(line[j:])
+                break
+            kept.append(line[j:start])
+            in_block = True
+            j = start + 2
+        s = "".join(kept)
+        if s.lstrip().startswith("//"):
+            s = ""
+        if _RE_BODY_KW.match(s):
+            in_body = True
+        out.append(s)
+    return "\n".join(out)
+
+
+def _split_top(s: str, sep: str) -> list[str]:
+    """Split on ``sep`` at paren depth 0 (guards/ternaries contain parens)."""
+    out, depth, cur = [], 0, []
+    for ch in s:
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        if ch == sep and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# parsed representation
+# ---------------------------------------------------------------------------
+
+class _Arrow:
+    __slots__ = ("direction", "guard_src", "then_tgt", "else_tgt", "line")
+
+    def __init__(self, direction, guard_src, then_tgt, else_tgt, line) -> None:
+        self.direction = direction      # "in" | "out"
+        self.guard_src = guard_src      # str | None
+        self.then_tgt = then_tgt        # (kind, name, flow, args_src)
+        self.else_tgt = else_tgt        # same | None
+        self.line = line
+
+
+class _FlowDecl:
+    __slots__ = ("access", "name", "arrows")
+
+    def __init__(self, access, name) -> None:
+        self.access = access
+        self.name = name
+        self.arrows: list[_Arrow] = []
+
+
+class _TaskDecl:
+    __slots__ = ("name", "params", "ranges", "affinity_src", "flows",
+                 "priority_src", "bodies", "line")
+
+    def __init__(self, name, params, line) -> None:
+        self.name = name
+        self.params = params
+        self.ranges: dict[str, tuple[str, str, str | None]] = {}
+        self.affinity_src: tuple[str, str] | None = None  # (collection, args)
+        self.flows: list[_FlowDecl] = []
+        self.priority_src: str | None = None
+        self.bodies: list[tuple[dict, str]] = []          # (props, code)
+        self.line = line
+
+
+class JDF:
+    """A parsed JDF template; :meth:`build` binds globals and materializes
+    the taskpool (the ``parsec_<name>_new`` generated-constructor analog)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.prologue_src: list[str] = []
+        self.globals_decl: dict[str, dict] = {}   # name -> props
+        self.tasks: dict[str, _TaskDecl] = {}
+
+    # -- build ---------------------------------------------------------------
+    def build(self, **bindings: Any) -> PTGTaskpool:
+        ns: dict[str, Any] = {}
+        for src in self.prologue_src:
+            exec(compile(src, f"<jdf:{self.name}:prologue>", "exec"), ns)
+        ns.pop("__builtins__", None)
+
+        for gname, props in self.globals_decl.items():
+            if gname in bindings:
+                continue
+            if "default" in props:
+                env = dict(ns)
+                env.update(bindings)
+                bindings[gname] = eval(
+                    compile(props["default"], "<jdf:default>", "eval"), env)
+            else:
+                raise JDFError(f"global '{gname}' needs a value at build()")
+        for gname in bindings:
+            if gname not in self.globals_decl:
+                raise JDFError(f"build() got unknown global '{gname}'")
+
+        self._sanity_check()
+        builder = PTGBuilder(self.name, **bindings)
+
+        def expr(src: str) -> Callable:
+            code = compile(src.strip(), f"<jdf:{self.name}>", "eval")
+
+            def fn(g, l):
+                # everything goes in eval's *globals*: comprehension scopes
+                # inside the expression cannot see an eval-locals mapping
+                env = dict(ns)
+                env.update(vars(g))
+                env.update(vars(l))
+                return eval(code, env)
+            return fn
+
+        for td in self.tasks.values():
+            params = {}
+            for p in td.params:
+                lo, hi, step = td.ranges[p]
+                params[p] = _mk_range(expr(lo), expr(hi),
+                                      expr(step) if step else None)
+            tcb = builder.task(td.name, **params)
+            if td.affinity_src is not None:
+                coll, args = td.affinity_src
+                key_fn = _mk_key(expr, args)
+                tcb.affinity(coll, key_fn)
+            if td.priority_src is not None:
+                tcb.priority(expr(td.priority_src))
+            for fd in td.flows:
+                fb = tcb.flow(fd.name, fd.access)
+                for ar in fd.arrows:
+                    self._attach_arrow(fb, ar, fd, td, expr)
+            for props, code_str in td.bodies:
+                btype = props.get("type", "python")
+                if btype in ("python", "cpu"):
+                    tcb.body(_mk_body(code_str, ns, td.name))
+                else:
+                    dyld = props.get("dyld")
+                    if not dyld:
+                        raise JDFError(
+                            f"{td.name}: device BODY needs dyld = <kernel>")
+                    tcb.body(device=btype, dyld=dyld)
+        return builder.build()
+
+    # -- arrows --------------------------------------------------------------
+    def _attach_arrow(self, fb, ar: _Arrow, fd: _FlowDecl, td: _TaskDecl,
+                      expr) -> None:
+        guard = expr(ar.guard_src) if ar.guard_src else None
+        neg = (lambda g, l: not guard(g, l)) if guard else None
+        for tgt, gfn in ((ar.then_tgt, guard),
+                        (ar.else_tgt, neg if ar.else_tgt else None)):
+            if tgt is None:
+                continue
+            kind, name, flow, args_src = tgt
+            if kind == "task":
+                t_decl = self.tasks[name]
+                args = [a.strip() for a in _split_top(args_src, ",")]
+                if len(args) != len(t_decl.params):
+                    raise JDFError(
+                        f"line {ar.line}: {name}() takes "
+                        f"{len(t_decl.params)} params, got {len(args)}")
+                arg_fns = [expr(a) for a in args]
+                pnames = list(t_decl.params)
+
+                def params_fn(g, l, _fns=arg_fns, _ps=pnames):
+                    return {p: fn(g, l) for p, fn in zip(_ps, _fns)}
+
+                ref = (name, flow, params_fn)
+                if ar.direction == "in":
+                    fb.input(pred=ref, guard=gfn)
+                else:
+                    fb.output(succ=ref, guard=gfn)
+            else:   # data
+                if fd.access == CTL:
+                    raise JDFError(
+                        f"line {ar.line}: CTL flow {fd.name} cannot "
+                        f"reference data {name}()")
+                key_fn = _mk_key(expr, args_src)
+                if ar.direction == "in":
+                    fb.input(data=(name, key_fn), guard=gfn)
+                else:
+                    fb.output(data=(name, key_fn), guard=gfn)
+
+    # -- sanity (jdf_sanity_checks analog) -----------------------------------
+    def _sanity_check(self) -> None:
+        data_globals = {g for g, p in self.globals_decl.items()
+                        if p.get("type") == "data"}
+        for td in self.tasks.values():
+            for p in td.params:
+                if p not in td.ranges:
+                    raise JDFError(
+                        f"{td.name}: parameter '{p}' has no range line")
+            for p in td.ranges:
+                if p not in td.params:
+                    raise JDFError(
+                        f"{td.name}: range for '{p}' which is not a "
+                        f"parameter")
+            if td.affinity_src is not None \
+                    and td.affinity_src[0] not in data_globals:
+                raise JDFError(
+                    f"{td.name}: affinity references '{td.affinity_src[0]}' "
+                    f"which is not a [type = data] global")
+            if not td.bodies:
+                raise JDFError(f"{td.name}: no BODY")
+            seen_flows = set()
+            for fd in td.flows:
+                if fd.name in seen_flows:
+                    raise JDFError(f"{td.name}: duplicate flow {fd.name}")
+                seen_flows.add(fd.name)
+                for ar in fd.arrows:
+                    for tgt in (ar.then_tgt, ar.else_tgt):
+                        if tgt is None:
+                            continue
+                        kind, name, flow, _args = tgt
+                        if kind == "task":
+                            if name not in self.tasks:
+                                raise JDFError(
+                                    f"line {ar.line}: unknown task class "
+                                    f"'{name}'")
+                            t_flows = {f.name for f in
+                                       self.tasks[name].flows}
+                            if flow not in t_flows:
+                                raise JDFError(
+                                    f"line {ar.line}: {name} has no flow "
+                                    f"'{flow}'")
+                        elif name not in data_globals:
+                            raise JDFError(
+                                f"line {ar.line}: '{name}' is neither a "
+                                f"task class (missing flow name?) nor a "
+                                f"[type = data] global")
+                    if fd.access == WRITE and ar.direction == "in" \
+                            and any(t is not None and t[0] == "task"
+                                    for t in (ar.then_tgt, ar.else_tgt)):
+                        raise JDFError(
+                            f"line {ar.line}: WRITE flow {fd.name} cannot "
+                            f"have a task input dependency")
+
+
+def _mk_range(lo_fn, hi_fn, step_fn):
+    def rng(g, l):
+        step = int(step_fn(g, l)) if step_fn else 1
+        hi = int(hi_fn(g, l))
+        # JDF ranges are inclusive of hi in the step direction
+        return range(int(lo_fn(g, l)), hi + (1 if step > 0 else -1), step)
+    return rng
+
+
+def _mk_key(expr, args_src: str):
+    fns = [expr(a) for a in _split_top(args_src, ",") if a.strip()]
+
+    def key_fn(g, l):
+        return tuple(fn(g, l) for fn in fns)
+    return key_fn
+
+
+def _mk_body(code_str: str, prologue_ns: dict, tname: str):
+    code = compile(_dedent(code_str), f"<jdf:{tname}:body>", "exec")
+
+    def body(es, task, g, l):
+        env = dict(prologue_ns)
+        env.update(vars(g))
+        env.update(vars(l))
+        env["es"], env["task"] = es, task
+        before = {}
+        for f in task.task_class.flows:
+            if f.is_ctl:
+                continue
+            copy = task.data[f.flow_index]
+            before[f.name] = copy.value if copy is not None else None
+            env[f.name] = before[f.name]
+        exec(code, env)
+        for f in task.task_class.flows:   # functional rebinds write back
+            if f.is_ctl:
+                continue
+            copy = task.data[f.flow_index]
+            if copy is not None and env.get(f.name) is not before[f.name]:
+                copy.value = env[f.name]
+
+    return body
+
+
+def _dedent(code: str) -> str:
+    import textwrap
+    return textwrap.dedent(code)
+
+
+# ---------------------------------------------------------------------------
+# the parser
+# ---------------------------------------------------------------------------
+
+_RE_GLOBAL = re.compile(r"^(\w+)\s*(?:=\s*(?P<default>[^\[]+?))?\s*"
+                        r"(?:\[(?P<props>[^\]]*)\])?\s*$")
+_RE_TASK = re.compile(r"^(\w+)\s*\(([\w\s,]*)\)\s*$")
+_RE_RANGE = re.compile(r"^(\w+)\s*=\s*(.+)$")
+_RE_FLOW = re.compile(r"^(RW|READ|WRITE|CTL)\s+(\w+)\s*(.*)$")
+_RE_TARGET_TASK = re.compile(r"^(\w+)\s+(\w+)\s*\((.*)\)$")
+_RE_TARGET_DATA = re.compile(r"^(\w+)\s*\((.*)\)$")
+
+
+_RE_PROP = re.compile(r"(\w+)\s*=\s*([\w.\-]+)|(\w+)")
+
+
+def _parse_props(s: str | None) -> dict:
+    out = {}
+    if not s:
+        return out
+    for m in _RE_PROP.finditer(s):
+        if m.group(1):
+            out[m.group(1)] = m.group(2)
+        else:
+            out[m.group(3)] = True
+    return out
+
+
+def parse_jdf(text: str, name: str = "jdf") -> JDF:
+    jdf = JDF(name)
+
+    # %{ ... %} prologues come out first: their content is Python and must
+    # not be touched by JDF comment stripping
+    def grab_prologue(m):
+        jdf.prologue_src.append(m.group(1))
+        return "\n" * m.group(0).count("\n")
+    text = re.sub(r"%\{(.*?)%\}", grab_prologue, text, flags=re.S)
+    text = _strip_comments(text)
+
+    lines = text.split("\n")
+    i, n = 0, len(lines)
+    cur: _TaskDecl | None = None
+    cur_flow: _FlowDecl | None = None
+
+    def err(msg):
+        raise JDFError(f"line {i + 1}: {msg}")
+
+    while i < n:
+        raw = lines[i]
+        line = raw.strip()
+        if not line:
+            i += 1
+            continue
+
+        if line.startswith("%"):
+            i += 1          # %option etc.: accepted and ignored
+            continue
+
+        if _RE_BODY_KW.match(line):
+            if cur is None:
+                err("BODY outside a task class")
+            props = _parse_props(
+                line[4:].strip().strip("[]") if "[" in line else None)
+            body_lines = []
+            i += 1
+            while i < n and lines[i].strip() != "END":
+                body_lines.append(lines[i])
+                i += 1
+            if i >= n:
+                raise JDFError(f"{cur.name}: BODY without END")
+            cur.bodies.append((props, "\n".join(body_lines)))
+            cur_flow = None
+            i += 1
+            continue
+
+        m = _RE_TASK.match(line)
+        if m and ".." not in line and not line.startswith(":"):
+            cur = _TaskDecl(
+                m.group(1),
+                [p.strip() for p in m.group(2).split(",") if p.strip()],
+                i + 1)
+            if cur.name in jdf.tasks:
+                err(f"duplicate task class {cur.name}")
+            jdf.tasks[cur.name] = cur
+            cur_flow = None
+            i += 1
+            continue
+
+        if cur is None:
+            mg = _RE_GLOBAL.match(line)
+            if not mg:
+                err(f"bad global declaration: {line!r}")
+            props = _parse_props(mg.group("props"))
+            if mg.group("default"):
+                props["default"] = mg.group("default").strip()
+            jdf.globals_decl[mg.group(1)] = props
+            i += 1
+            continue
+
+        # inside a task class ------------------------------------------------
+        if line.startswith(":"):
+            md = _RE_TARGET_DATA.match(line[1:].strip())
+            if not md:
+                err(f"bad affinity: {line!r}")
+            cur.affinity_src = (md.group(1), md.group(2))
+            cur_flow = None
+            i += 1
+            continue
+
+        if line.startswith(";"):
+            cur.priority_src = line[1:].strip()
+            cur_flow = None
+            i += 1
+            continue
+
+        if line.startswith("<-") or line.startswith("->"):
+            if cur_flow is None:
+                err("dependency arrow outside a flow declaration")
+            _parse_arrows(cur_flow, line, i + 1, err)
+            i += 1
+            continue
+
+        mf = _RE_FLOW.match(line)
+        if mf:
+            cur_flow = _FlowDecl(_ACCESS[mf.group(1)], mf.group(2))
+            cur.flows.append(cur_flow)
+            rest = mf.group(3).strip()
+            if rest:
+                _parse_arrows(cur_flow, rest, i + 1, err)
+            i += 1
+            continue
+
+        mr = _RE_RANGE.match(line)
+        if mr and mr.group(1) in cur.params:
+            parts = [p.strip() for p in mr.group(2).split("..")]
+            if len(parts) == 1:
+                # fixed value: a singleton range
+                cur.ranges[mr.group(1)] = (parts[0], parts[0], None)
+            elif len(parts) == 2:
+                cur.ranges[mr.group(1)] = (parts[0], parts[1], None)
+            elif len(parts) == 3:
+                cur.ranges[mr.group(1)] = (parts[0], parts[1], parts[2])
+            else:
+                err(f"bad range: {line!r}")
+            cur_flow = None
+            i += 1
+            continue
+
+        err(f"cannot parse: {line!r}")
+
+    return jdf
+
+
+def _parse_arrows(fd: _FlowDecl, s: str, lineno: int, err) -> None:
+    """Parse one line of ``<- ...`` / ``-> ...`` arrow segments (a line may
+    chain several, as JDF flows often put the first arrow on the flow line)."""
+    # tokenize into (direction, segment) pairs by splitting on top-level
+    # <- / -> occurrences
+    segs: list[tuple[str, str]] = []
+    depth = 0
+    j = 0
+    start = None
+    direction = None
+    while j < len(s):
+        ch = s[j]
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        if depth == 0 and s[j:j + 2] in ("<-", "->"):
+            if direction is not None:
+                segs.append((direction, s[start:j].strip()))
+            direction = "in" if s[j] == "<" else "out"
+            j += 2
+            start = j
+            continue
+        j += 1
+    if direction is None:
+        err(f"expected <- or -> in {s!r}")
+    segs.append((direction, s[start:].strip()))
+
+    for direction, seg in segs:
+        if not seg:
+            err("empty dependency arrow")
+        guard_src = None
+        then_src, else_src = seg, None
+        q = _split_top(seg, "?")
+        if len(q) == 2:
+            guard_src = q[0].strip()
+            if not (guard_src.startswith("(") and guard_src.endswith(")")):
+                err(f"guard must be parenthesized: {guard_src!r}")
+            branches = _split_top(q[1], ":")
+            then_src = branches[0].strip()
+            if len(branches) == 2:
+                else_src = branches[1].strip()
+            elif len(branches) > 2:
+                err(f"too many ':' in {seg!r}")
+        elif len(q) > 2:
+            err(f"too many '?' in {seg!r}")
+        then_tgt = _parse_target(then_src, err)
+        else_tgt = _parse_target(else_src, err) if else_src else None
+        fd.arrows.append(_Arrow(direction, guard_src, then_tgt, else_tgt,
+                                lineno))
+
+
+def _parse_target(s: str, err) -> tuple:
+    mt = _RE_TARGET_TASK.match(s)
+    if mt:
+        return ("task", mt.group(2), mt.group(1), mt.group(3))
+    md = _RE_TARGET_DATA.match(s)
+    if md:
+        return ("data", md.group(1), None, md.group(2))
+    err(f"cannot parse dependency target {s!r}")
