@@ -58,6 +58,11 @@ class TiledMatrix(DataCollection):
         w = min(self.nb, self.ln - n * self.nb)
         return (h, w)
 
+    def has_tile(self, m: int, n: int) -> bool:
+        """Whether this storage variant materializes tile (m, n) — False for
+        e.g. the upper tiles of a lower-symmetric or off-band tiles."""
+        return 0 <= m < self.mt and 0 <= n < self.nt
+
     def rank_of(self, m: int, n: int) -> int:
         return 0
 
@@ -146,6 +151,12 @@ class SymTwoDimBlockCyclic(TwoDimBlockCyclic):
         self._check(m, n)
         return super().rank_of(m, n)
 
+    def has_tile(self, m: int, n: int) -> bool:
+        if not super().has_tile(m, n):
+            return False
+        return not (self.uplo == self.LOWER and n > m
+                    or self.uplo == self.UPPER and m > n)
+
 
 class TwoDimTabular(TiledMatrix):
     """Arbitrary tile→rank table (``two_dim_tabular.c``) — the substrate for
@@ -193,6 +204,87 @@ class VectorTwoDimCyclic(DataCollection):
                                 dtt=TileType((size,), self.dtype), dc=self)
                 self._store[(m,)] = d
             return d
+
+
+class TwoDimBlockCyclicBand(TwoDimBlockCyclic):
+    """Band-matrix storage over block-cyclic: only tiles within
+    ``band_size`` of the diagonal exist (``two_dim_rectangle_cyclic_band.c``).
+    Band tiles may use a distinct 1-D distribution (here: cyclic over P*Q by
+    diagonal index) while off-band access raises."""
+
+    def __init__(self, *args, band_size: int = 1, **kw) -> None:
+        super().__init__(*args, **kw)
+        self.band_size = band_size
+
+    def _in_band(self, m: int, n: int) -> bool:
+        return abs(m - n) < self.band_size
+
+    def _check(self, m: int, n: int) -> None:
+        if not self._in_band(m, n):
+            raise KeyError(f"tile ({m},{n}) outside band {self.band_size}")
+
+    def rank_of(self, m: int, n: int) -> int:
+        self._check(m, n)
+        # band tiles ride a 1-D cyclic layout along the diagonal so the band
+        # stays balanced however thin it is
+        return min(m, n) % max(self.nodes, 1)
+
+    def data_of(self, m: int, n: int) -> Data:
+        self._check(m, n)
+        return super().data_of(m, n)
+
+    def has_tile(self, m: int, n: int) -> bool:
+        return super().has_tile(m, n) and self._in_band(m, n)
+
+
+class SymTwoDimBlockCyclicBand(SymTwoDimBlockCyclic):
+    """Symmetric band storage (``sym_two_dim_rectangle_cyclic_band.c``)."""
+
+    def __init__(self, *args, band_size: int = 1, **kw) -> None:
+        super().__init__(*args, **kw)
+        self.band_size = band_size
+
+    def _check(self, m: int, n: int) -> None:
+        super()._check(m, n)
+        if abs(m - n) >= self.band_size:
+            raise KeyError(f"tile ({m},{n}) outside band {self.band_size}")
+
+    def has_tile(self, m: int, n: int) -> bool:
+        return super().has_tile(m, n) and abs(m - n) < self.band_size
+
+
+class SubtileCollection(TiledMatrix):
+    """Recursive sub-tiling of one parent tile (``matrix/subtile.c``): views
+    a single (mb, nb) tile as an (sub_mb, sub_nb) tiled matrix so recursive
+    task bodies can spawn a nested taskpool over it
+    (``PARSEC_DEV_RECURSIVE`` device, ``device.h:64``).
+
+    Sub-tiles are numpy views: *in-place* writes land in the parent tile's
+    host array directly (bodies that rebind replace only the sub copy).
+    Coherency: when used inside an enclosing task that holds the parent
+    tile under a RW flow — the recursive-device pattern — the outer task's
+    completion bumps versions; standalone users sharing the parent with a
+    device must call :meth:`sync_parent` after the nested taskpool drains.
+    """
+
+    def __init__(self, parent: TiledMatrix, m: int, n: int,
+                 sub_mb: int, sub_nb: int) -> None:
+        self.parent = parent
+        self.parent_copy = parent.data_of(m, n).newest_copy()
+        array = np.asarray(self.parent_copy.value)
+
+        def view(mm, nn, shape):
+            return array[mm * sub_mb:mm * sub_mb + shape[0],
+                         nn * sub_nb:nn * sub_nb + shape[1]]
+
+        # np.asarray of a matching-dtype slice keeps the view: no copy
+        super().__init__(f"{parent.name}[{m},{n}]", array.shape[0],
+                         array.shape[1], sub_mb, sub_nb, dtype=array.dtype,
+                         init_fn=view)
+
+    def sync_parent(self) -> None:
+        """Mark the parent tile's host copy newer than any device copy."""
+        self.parent_copy.version += 1
 
 
 class HashDataDist(DataCollection):

@@ -1,0 +1,174 @@
+"""In-collection operator taskpools: map / reduce / broadcast.
+
+Rebuild of the reference's generic collection operators
+(``parsec/data_dist/matrix/map_operator.c``, ``apply.jdf``, ``reduce*.jdf``,
+``broadcast.jdf``, SURVEY §2.9): reusable taskpools applying an elementwise
+operator, a tree reduction, or a one-to-all propagation over every tile of a
+collection — the same building blocks the reference uses for DP-style
+collective math.
+
+Multi-rank: map runs rank-local per tile ownership; reduce uses a binomial
+combine tree whose cross-rank hops ride the remote-dep activation protocol;
+broadcast reuses the runtime's own propagation trees by fanning one
+producer's flow out to per-rank consumer tasks (the ``Ex05`` shape).
+
+TPU-first note: for dense regular collections these operators also lower to
+single XLA programs (a ``jax.tree_util``-style map or a ``psum`` over a
+mesh); the taskpool forms here are the general/irregular path, and the
+parallel pack (:mod:`parsec_tpu.parallel`) provides the compiled
+equivalents.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from .. import ptg
+from .collection import DataCollection
+
+
+def _all_keys(dc: DataCollection) -> list[tuple]:
+    """Enumerate every *materialized* tile key of a tiled collection (the
+    storage variant's ``has_tile`` filters symmetric/band holes)."""
+    if hasattr(dc, "mt") and hasattr(dc, "nt"):
+        has = getattr(dc, "has_tile", lambda m, n: True)
+        return [(m, n) for m in range(dc.mt) for n in range(dc.nt)
+                if has(m, n)]
+    if hasattr(dc, "mt"):
+        return [(m,) for m in range(dc.mt)]
+    raise TypeError(f"cannot enumerate keys of {type(dc).__name__}")
+
+
+def map_taskpool(dc: DataCollection, fn: Callable[..., Any],
+                 name: str = "map") -> ptg.PTGTaskpool:
+    """Apply ``fn(key, tile) -> tile|None`` to every tile, in place or by
+    returning a replacement (``parsec_map_operator`` / ``apply.jdf``)."""
+    keys = _all_keys(dc)
+    p = ptg.PTGBuilder(name, DC=dc, KEYS=keys, FN=fn)
+    t = p.task("MAP", i=ptg.span(0, lambda g, l: len(g.KEYS) - 1))
+    t.affinity("DC", lambda g, l: g.KEYS[l.i])
+    f = t.flow("T", ptg.RW)
+    f.input(data=("DC", lambda g, l: g.KEYS[l.i]))
+    f.output(data=("DC", lambda g, l: g.KEYS[l.i]))
+
+    def body(es, task, g, l):
+        copy = task.flow_data("T")
+        out = g.FN(g.KEYS[l.i], copy.value)
+        if out is not None:
+            copy.value = out
+
+    t.body(body)
+    return p.build()
+
+
+def reduce_taskpool(dc: DataCollection, op: Callable[[Any, Any], Any],
+                    out: dict | None = None,
+                    transform: Callable[[Any], Any] | None = None,
+                    name: str = "reduce") -> ptg.PTGTaskpool:
+    """Binomial-tree reduction of every tile into ``out['value']`` on the
+    rank owning the first key (``reduce*.jdf`` shape).
+
+    ``op(acc, partial) -> acc`` must be associative.  ``transform`` maps
+    each *tile* to its level-0 partial (identity by default) — required
+    when tiles are ragged and ``op`` is elementwise (e.g. ``np.sum`` for a
+    scalar total).  Tree level ``s`` combines element ``i`` with element
+    ``i + 2**s``; cross-rank combines ship partials through the activation
+    protocol.
+    """
+    keys = _all_keys(dc)
+    n = len(keys)
+    levels = max(1, (n - 1).bit_length())
+    result = out if out is not None else {}
+    p = ptg.PTGBuilder(name, DC=dc, KEYS=keys, OP=op, N=n, LVL=levels,
+                       OUT=result, TF=transform or (lambda t: t))
+
+    # RED(s, i): combine partial i with partial i + 2**s at level s.
+    # Level 0 reads tiles; level s>0 reads RED(s-1, .) partials.
+    t = p.task("RED",
+               s=ptg.span(0, lambda g, l: g.LVL - 1),
+               i=ptg.span(0, lambda g, l: max(0, (g.N + (1 << (l.s + 1)) - 1)
+                                              // (1 << (l.s + 1)) - 1)))
+    t.affinity("DC", lambda g, l: g.KEYS[l.i << (l.s + 1)])
+
+    def _stride(l):
+        return 1 << l.s
+
+    fa = t.flow("ACC", ptg.RW)
+    fa.input(data=("DC", lambda g, l: g.KEYS[l.i * 2 * _stride(l)]),
+             guard=lambda g, l: l.s == 0)
+    fa.input(pred=("RED", "ACC", lambda g, l: {"s": l.s - 1, "i": l.i * 2}),
+             guard=lambda g, l: l.s > 0)
+    fa.output(succ=("RED", "ACC",
+                    lambda g, l: {"s": l.s + 1, "i": l.i // 2}),
+              guard=lambda g, l: l.s < g.LVL - 1 and l.i % 2 == 0)
+    fa.output(succ=("RED", "RHS",
+                    lambda g, l: {"s": l.s + 1, "i": l.i // 2}),
+              guard=lambda g, l: l.s < g.LVL - 1 and l.i % 2 == 1)
+
+    fb = t.flow("RHS", ptg.READ)
+    fb.input(data=("DC", lambda g, l: g.KEYS[(l.i * 2 + 1) * _stride(l)]),
+             guard=lambda g, l: l.s == 0
+             and (l.i * 2 + 1) * _stride(l) < g.N)
+    fb.input(pred=("RED", "ACC",
+                   lambda g, l: {"s": l.s - 1, "i": l.i * 2 + 1}),
+             guard=lambda g, l: l.s > 0
+             and (l.i * 2 + 1) * _stride(l) < g.N)
+
+    def body(es, task, g, l):
+        from ..data.data import data_create
+        a = np.asarray(task.flow_data("ACC").value)
+        rhs = task.flow_data("RHS")
+        b = None if rhs is None else np.asarray(rhs.value)
+        if l.s == 0:   # raw tiles map through the level-0 transform
+            a = g.TF(a)
+            b = g.TF(b) if b is not None else None
+        val = g.OP(a, b) if b is not None else a
+        if l.s == 0:
+            # detach the partial from the collection's home tile: the ACC
+            # chain rebinds values and must never clobber source data
+            task.set_flow_data(
+                "ACC", data_create(np.array(val),
+                                   key=(name, "part", l.i)).get_copy(0))
+        else:
+            task.flow_data("ACC").value = val
+        if l.s == g.LVL - 1:
+            g.OUT["value"] = np.asarray(task.flow_data("ACC").value)
+
+    t.body(body)
+    return p.build()
+
+
+def broadcast_taskpool(src: DataCollection, src_key: tuple,
+                       dst: DataCollection,
+                       name: str = "bcast") -> ptg.PTGTaskpool:
+    """Copy tile ``src(src_key)`` into ``dst(r,)`` for every segment ``r``
+    of the *destination* (``broadcast.jdf`` / Ex05 shape).  With multiple
+    ranks the one-producer many-consumer flow rides the runtime's binomial
+    propagation tree."""
+    p = ptg.PTGBuilder(name, SRC=src, DST=dst, KEY=src_key)
+    nodes = max(getattr(dst, "mt", dst.nodes), 1)
+
+    w = p.task("ROOT", z=ptg.span(0, 0))
+    w.affinity("SRC", lambda g, l: g.KEY)
+    fw = w.flow("A", ptg.RW)
+    fw.input(data=("SRC", lambda g, l: g.KEY))
+    for r in range(nodes):
+        fw.output(succ=("COPY", "X", lambda g, l, r=r: {"r": r}))
+    w.body(lambda es, task, g, l: None)
+
+    t = p.task("COPY", r=ptg.span(0, nodes - 1))
+    t.affinity("DST", lambda g, l: (l.r,))
+    fx = t.flow("X", ptg.READ)
+    fx.input(pred=("ROOT", "A", lambda g, l: {"z": 0}))
+    fy = t.flow("Y", ptg.RW)
+    fy.input(data=("DST", lambda g, l: (l.r,)))
+    fy.output(data=("DST", lambda g, l: (l.r,)))
+
+    def body(es, task, g, l):
+        task.flow_data("Y").value[...] = np.asarray(
+            task.flow_data("X").value)
+
+    t.body(body)
+    return p.build()

@@ -228,25 +228,36 @@ class DTDTaskpool(Taskpool):
         self._inflight = 0
         self._icond = threading.Condition()
         self._armed = False
+        self._closed = False
         self.window_size = _params.get("dtd_window_size")
         self.threshold_size = _params.get("dtd_threshold_size")
 
     # ------------------------------------------------------------- lifecycle
     def startup(self, context: Any) -> list[Task]:
-        # Hold one pending action until wait(): task counts are unknown until
-        # the app stops inserting (the DTD termdet discipline, §3.6).
-        self.tdm.taskpool_addto_nb_pa(+1)
-        self._armed = True
+        # Hold one pending action until wait()/close(): task counts are
+        # unknown until the app stops inserting (the DTD termdet discipline,
+        # §3.6).  A taskpool fully populated at enqueue (on_enqueue +
+        # close()) must not re-arm.
+        if not self._closed:
+            self.tdm.taskpool_addto_nb_pa(+1)
+            self._armed = True
         return []
 
     def nb_local_tasks(self) -> int:
         return -1
 
-    def wait(self, timeout: float | None = None) -> None:
-        """``parsec_dtd_taskpool_wait``: no more insertions; drain."""
+    def close(self) -> None:
+        """Declare insertion finished: drops the armed pending action so the
+        termination detector may conclude (needed when nobody calls
+        :meth:`wait` on this member — e.g. inside ``compose()``)."""
+        self._closed = True
         if self._armed:
             self._armed = False
             self.tdm.taskpool_addto_nb_pa(-1)
+
+    def wait(self, timeout: float | None = None) -> None:
+        """``parsec_dtd_taskpool_wait``: no more insertions; drain."""
+        self.close()
         super().wait(timeout)
 
     # ----------------------------------------------------------------- tiles
