@@ -237,6 +237,7 @@ class PTGTaskpool(Taskpool):
 
     def startup(self, context: Any) -> list:
         """Enumerate initially-ready local tasks (empty IN-dep mask)."""
+        from ..runtime.scheduling import resolve_data_inputs
         from ..runtime.task import Task
         multi = context.nb_ranks > 1
         out = []
@@ -254,6 +255,7 @@ class PTGTaskpool(Taskpool):
                 prio = tc.priority(locals_) if tc.priority else 0
                 t = Task(self, tc, locals_, priority=prio)
                 t.status = "ready"
+                resolve_data_inputs(t)  # snapshot collection reads now
                 out.append(t)
         return out
 

@@ -71,6 +71,8 @@ class DependencyTracking:
         task.data = list(trk.inputs)
         task.repo_entries = list(trk.repo_refs)
         task.status = "ready"
+        from .scheduling import resolve_data_inputs
+        resolve_data_inputs(task)   # snapshot collection reads at creation
         return task
 
     def __len__(self) -> int:

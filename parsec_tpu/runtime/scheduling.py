@@ -143,15 +143,16 @@ def task_progress(es: ExecutionStream, task: Task, distance: int) -> int:
 # data resolution
 # ---------------------------------------------------------------------------
 
-def prepare_input(es: ExecutionStream, task: Task) -> None:
-    """Generic data lookup (cf. generated ``data_lookup``, ``jdf2c.c:44``):
-    flows fed by predecessors already carry their copies (attached at dep
-    release); remaining flows resolve against the data collection or
-    allocate scratch."""
+def resolve_data_inputs(task: Task) -> None:
+    """Bind flows read directly from a data collection to their current
+    copies.  Called EAGERLY at task creation (startup enumeration / dep
+    release): a ``<- A(k)`` read observes the collection state as of the
+    moment the task came into existence — later writebacks to the same tile
+    by unordered tasks must not leak in (ordering, when needed, must be a
+    flow edge)."""
     tc = task.task_class
     if tc.prepare_input is not None:
-        tc.prepare_input(es, task)
-        return
+        return  # custom lookup owns its semantics (DTD binds at insert)
     for f in tc.flows:
         if f.is_ctl or task.data[f.flow_index] is not None:
             continue
@@ -167,7 +168,23 @@ def prepare_input(es: ExecutionStream, task: Task) -> None:
                         f"{task}: flow {f.name} has no valid copy")
                 task.data[f.flow_index] = copy
                 break
-        if task.data[f.flow_index] is None and f.dtt is not None:
+
+
+def prepare_input(es: ExecutionStream, task: Task) -> None:
+    """Generic data lookup (cf. generated ``data_lookup``, ``jdf2c.c:44``):
+    flows fed by predecessors already carry their copies (attached at dep
+    release); data-collection reads were bound at creation
+    (:func:`resolve_data_inputs`, re-run here as a safety net); WRITE-only
+    flows allocate scratch."""
+    tc = task.task_class
+    if tc.prepare_input is not None:
+        tc.prepare_input(es, task)
+        return
+    resolve_data_inputs(task)
+    for f in tc.flows:
+        if f.is_ctl or task.data[f.flow_index] is not None:
+            continue
+        if f.dtt is not None:
             # WRITE-only flow: allocate scratch of the declared tile type
             import numpy as np
 

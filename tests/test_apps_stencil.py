@@ -1,0 +1,70 @@
+"""1-D stencil app tests — the halo-exchange tier (tests/apps/stencil analog)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm import run_multirank
+from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
+from parsec_tpu.models.stencil import (stencil_1d_ptg, stencil_flops,
+                                       stencil_reference)
+from parsec_tpu.runtime import Context
+
+
+def _make_v(base, mb, nranks=1, rank=0):
+    return VectorTwoDimCyclic("V", lm=len(base), mb=mb, P=nranks,
+                              myrank=rank, dtype=np.float64,
+                              init_fn=lambda m, size:
+                              base[m * mb:m * mb + size])
+
+
+@pytest.mark.parametrize("nb_cores", [0, 3])
+@pytest.mark.parametrize("radius,iters", [(1, 1), (2, 4), (4, 7)])
+def test_stencil_matches_reference(nb_cores, radius, iters):
+    rng = np.random.default_rng(0)
+    base = rng.standard_normal(64).astype(np.float64)
+    V = _make_v(base, mb=16)
+    w = rng.standard_normal(2 * radius + 1)
+    tp = stencil_1d_ptg(V, w, iters)
+    ctx = Context(nb_cores=nb_cores)
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=60)
+    ctx.fini()
+    got = np.concatenate([V.data_of(i).newest_copy().value
+                          for i in range(V.mt)])
+    np.testing.assert_allclose(got, stencil_reference(base, w, iters),
+                               rtol=1e-10)
+
+
+def _stencil_rank_body(ctx, rank, nranks):
+    rng = np.random.default_rng(0)
+    base = rng.standard_normal(48).astype(np.float64)
+    V = _make_v(base, mb=8, nranks=nranks, rank=rank)
+    w = np.array([0.25, 0.5, 0.25])
+    tp = stencil_1d_ptg(V, w, 5)
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=90)
+    ctx.comm_barrier()
+    # gather this rank's tiles
+    out = {}
+    for i in range(V.mt):
+        if V.rank_of(i) == rank:
+            out[i] = np.asarray(V.data_of(i).newest_copy().value).copy()
+    return out
+
+
+@pytest.mark.parametrize("nranks", [2, 3])
+def test_stencil_multirank(nranks):
+    """Ghost regions cross ranks through the activation protocol."""
+    res = run_multirank(nranks, _stencil_rank_body, timeout=180)
+    rng = np.random.default_rng(0)
+    base = rng.standard_normal(48).astype(np.float64)
+    want = stencil_reference(base, np.array([0.25, 0.5, 0.25]), 5)
+    got = np.zeros_like(want)
+    for rank_out in res:
+        for i, tile in rank_out.items():
+            got[i * 8:(i + 1) * 8] = tile
+    np.testing.assert_allclose(got, want, rtol=1e-10)
+
+
+def test_stencil_flops_formula():
+    assert stencil_flops(100, 4, 10) == 2.0 * 9 * 100 * 10

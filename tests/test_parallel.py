@@ -1,0 +1,143 @@
+"""Parallelism pack: ring attention, Ulysses all-to-all, composed train step.
+
+Runs on the virtual 8-device CPU mesh (conftest).  These are the compiled
+(SPMD) realizations of SURVEY §2.12's strategy inventory; the dynamic-
+runtime realizations (halo PTG, redistribute) are tested in
+test_apps_stencil.py / test_collections.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from parsec_tpu.parallel.alltoall import make_ulysses_attention
+from parsec_tpu.parallel.ring import (dense_attention, make_ring_attention)
+from parsec_tpu.parallel.train import (init_params, init_transformer_params,
+                                       make_train_step,
+                                       make_transformer_train_step)
+
+
+def _mesh(shape: dict) -> Mesh:
+    import numpy as np
+    devs = np.array(jax.devices()[:int(np.prod(list(shape.values())))])
+    return Mesh(devs.reshape(tuple(shape.values())),
+                axis_names=tuple(shape.keys()))
+
+
+def _qkv(key, b=2, h=4, n=16, d=8, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (b, h, n, d), dtype) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_ring_attention_matches_dense(causal, sp):
+    mesh = _mesh({"sp": sp})
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    ring = make_ring_attention(mesh, causal=causal, batch_axis=None,
+                               head_axis=None)
+    got = ring(q, k, v)
+    want = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_full_mesh():
+    """dp × tp × sp simultaneously: batch, heads, and sequence all sharded."""
+    mesh = _mesh({"dp": 2, "tp": 2, "sp": 2})
+    q, k, v = _qkv(jax.random.PRNGKey(1), b=4, h=4, n=16, d=8)
+    ring = make_ring_attention(mesh, causal=True)
+    got = ring(q, k, v)
+    want = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False])
+def test_ulysses_matches_dense(causal):
+    """All-to-all head re-sharding computes identical attention."""
+    mesh = _mesh({"sp": 4})
+    q, k, v = _qkv(jax.random.PRNGKey(2), b=2, h=8, n=16, d=4)
+    ul = make_ulysses_attention(
+        mesh, lambda a, b_, c: dense_attention(a, b_, c, causal=causal),
+        batch_axis=None)
+    got = ul(q, k, v)
+    want = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_mlp_train_step_matches_single_device():
+    """The dp×tp sharded step computes the same update as unsharded math."""
+    mesh = _mesh({"dp": 2, "tp": 4})
+    params = init_params(jax.random.PRNGKey(0), 16, 32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 4, 16))
+    y = jax.random.normal(jax.random.PRNGKey(2), (8, 4, 16))
+    step = make_train_step(mesh, lr=0.1)
+    p2, loss = step(params, x, y)
+
+    def ref_loss(p):
+        h = jax.nn.relu(x @ p["w1"])
+        return jnp.mean((h @ p["w2"] - y) ** 2)
+
+    rl, rg = jax.value_and_grad(ref_loss)(params)
+    np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p2[k]),
+                                   np.asarray(params[k] - 0.1 * rg[k]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_train_step_matches_single_device():
+    """Flagship dp×tp×sp step (ring attention inside) equals unsharded
+    transformer-block SGD.
+
+    Params are scaled 25x from init so a missing Megatron f-operator
+    (tp-local activation cotangents) shows up orders of magnitude above
+    the tolerance instead of hiding in fp32 noise."""
+    mesh = _mesh({"dp": 2, "tp": 2, "sp": 2})
+    n_heads, d_head, d_model, d_ff = 4, 4, 16, 32
+    params = init_transformer_params(jax.random.PRNGKey(0), d_model,
+                                     n_heads, d_head, d_ff)
+    params = jax.tree.map(lambda p: p * 25.0, params)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8, d_model))
+    y = jax.random.normal(jax.random.PRNGKey(2), (4, 8, d_model))
+    step = make_transformer_train_step(mesh, n_heads, d_head, lr=0.05,
+                                       causal=True)
+    p2, loss = step(params, x, y)
+
+    def ref_block(p, xx):
+        b, s, d = xx.shape
+        def heads(t):
+            return t.reshape(b, s, n_heads, d_head).transpose(0, 2, 1, 3)
+        a = dense_attention(heads(xx @ p["wq"]), heads(xx @ p["wk"]),
+                            heads(xx @ p["wv"]), causal=True)
+        a = a.transpose(0, 2, 1, 3).reshape(b, s, n_heads * d_head)
+        xx = xx + a @ p["wo"]
+        return xx + jax.nn.relu(xx @ p["w1"]) @ p["w2"]
+
+    def ref_loss(p):
+        return jnp.mean((ref_block(p, x) - y) ** 2)
+
+    rl, rg = jax.value_and_grad(ref_loss)(params)
+    np.testing.assert_allclose(float(loss), float(rl), rtol=1e-4)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p2[k]),
+                                   np.asarray(params[k] - 0.05 * rg[k]),
+                                   rtol=1e-3, atol=1e-5)
+
+
+def test_transformer_loss_decreases():
+    mesh = _mesh({"dp": 2, "tp": 2, "sp": 2})
+    params = init_transformer_params(jax.random.PRNGKey(0), 16, 4, 4, 32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8, 16))
+    y = x * 0.5
+    step = make_transformer_train_step(mesh, 4, 4, lr=0.1)
+    losses = []
+    for _ in range(5):
+        params, loss = step(params, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
