@@ -1,6 +1,21 @@
-"""Observability (rebuild of PINS/profiling, SURVEY §2.10, §5.1)."""
+"""Observability (rebuild of PINS/profiling/grapher/SDE, SURVEY §2.10, §5.1).
+
+- :mod:`pins` — instrumentation callback chains on runtime events;
+- :mod:`profiling` — dictionary-keyed binary traces + pandas converter;
+- :mod:`task_profiler` — the PINS→trace bridge module;
+- :mod:`grapher` — executed-DAG DOT output;
+- :mod:`counters` — SDE-style counters + the live properties dictionary.
+"""
 
 from . import pins
 from .pins import PinsEvent
+from .profiling import Profiling
+from .profiling import profiling as trace_state   # the global instance —
+# exported under a distinct name so it cannot shadow the submodule
+# ``parsec_tpu.prof.profiling`` on the package object
+from .counters import properties, sde
+from . import task_profiler as _task_profiler   # register components
+from . import grapher as _grapher               # register components
 
-__all__ = ["PinsEvent", "pins"]
+__all__ = ["PinsEvent", "pins", "Profiling", "trace_state", "properties",
+           "sde"]

@@ -1,0 +1,172 @@
+"""Software-defined counters + the live properties dictionary.
+
+Rebuild of two observability surfaces (SURVEY §5.5):
+
+- **SDE counters** (``papi_sde.c``): named process-wide counters and gauges
+  external profilers can sample — tasks enabled/retired, scheduler queue
+  depths (``PARSEC_PAPI_SDE_TASKS_ENABLED/RETIRED``).  The built-in
+  :class:`SdePinsModule` feeds the task counters from PINS events.
+- **Properties dictionary** (``dictionary.c`` + ``tools/aggregator_visu``):
+  a registry of (namespace, property, getter) triples snapshot on demand
+  and optionally streamed to a JSON file on an interval for live
+  dashboards (the shared-memory segment of the reference becomes a file a
+  dashboard tails).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Callable
+
+from ..core.mca import Component, component
+from . import pins
+from .pins import PinsEvent
+
+
+class SdeCounters:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+        self._gauges: dict[str, Callable[[], float]] = {}
+
+    def inc(self, name: str, delta: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + delta
+
+    def register_gauge(self, name: str, fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._gauges[name] = fn
+
+    def unregister_gauge(self, name: str) -> None:
+        with self._lock:
+            self._gauges.pop(name, None)
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            out = dict(self._counters)
+            gauges = list(self._gauges.items())
+        for name, fn in gauges:
+            try:
+                out[name] = fn()
+            except Exception:
+                out[name] = float("nan")
+        return out
+
+    def get(self, name: str) -> float:
+        return self.snapshot().get(name, 0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+
+
+sde = SdeCounters()
+
+TASKS_ENABLED = "parsec::tasks_enabled"
+TASKS_RETIRED = "parsec::tasks_retired"
+
+
+class SdePinsModule:
+    """Feeds the canonical task counters from the PINS chain."""
+
+    def __init__(self) -> None:
+        self._cbs: list[tuple[PinsEvent, Any]] = []
+
+    def install(self) -> None:
+        def on_sched(es, tasks):
+            n = len(tasks) if isinstance(tasks, list) else 1
+            sde.inc(TASKS_ENABLED, n)
+
+        def on_done(es, task):
+            sde.inc(TASKS_RETIRED)
+
+        pins.register(PinsEvent.SCHEDULE_BEGIN, on_sched)
+        pins.register(PinsEvent.COMPLETE_EXEC_END, on_done)
+        self._cbs = [(PinsEvent.SCHEDULE_BEGIN, on_sched),
+                     (PinsEvent.COMPLETE_EXEC_END, on_done)]
+
+    def uninstall(self) -> None:
+        for ev, cb in self._cbs:
+            pins.unregister(ev, cb)
+        self._cbs.clear()
+
+
+@component
+class SdeComponent(Component):
+    type_name = "pins"
+    name = "sde"
+    priority = 4
+
+    def query(self, context: Any = None) -> bool:
+        return False
+
+    def open(self, context: Any = None) -> SdePinsModule:
+        m = SdePinsModule()
+        m.install()
+        return m
+
+    def close(self, module: SdePinsModule) -> None:
+        module.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# properties dictionary
+# ---------------------------------------------------------------------------
+
+class PropertiesDictionary:
+    """(namespace, property) -> getter registry with snapshot/streaming."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._props: dict[tuple[str, str], Callable[[], Any]] = {}
+        self._stream_stop: threading.Event | None = None
+
+    def register(self, namespace: str, name: str,
+                 fn: Callable[[], Any]) -> None:
+        with self._lock:
+            self._props[(namespace, name)] = fn
+
+    def unregister(self, namespace: str, name: str) -> None:
+        with self._lock:
+            self._props.pop((namespace, name), None)
+
+    def snapshot(self) -> dict[str, dict[str, Any]]:
+        with self._lock:
+            items = list(self._props.items())
+        out: dict[str, dict[str, Any]] = {}
+        for (ns, name), fn in items:
+            try:
+                out.setdefault(ns, {})[name] = fn()
+            except Exception as e:
+                out.setdefault(ns, {})[name] = f"<error: {e}>"
+        return out
+
+    def stream_to(self, path: str, interval: float = 0.5) -> Callable[[], None]:
+        """Write JSON snapshots to ``path`` every ``interval`` seconds until
+        the returned stop function is called (live-dashboard feed)."""
+        stop = threading.Event()
+
+        def run() -> None:
+            while not stop.is_set():
+                snap = {"ts": time.time(), "props": self.snapshot()}
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(snap, f)
+                import os
+                os.replace(tmp, path)
+                stop.wait(interval)
+
+        th = threading.Thread(target=run, daemon=True,
+                              name="parsec-props-stream")
+        th.start()
+
+        def stopper() -> None:
+            stop.set()
+            th.join(timeout=5)
+
+        return stopper
+
+
+properties = PropertiesDictionary()
