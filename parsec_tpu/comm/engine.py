@@ -137,6 +137,9 @@ class CommEngine:
     # -- registered memory / one-sided ---------------------------------------
     def mem_register(self, value: Any, refcount: int = 1,
                      on_drained: Callable[[], None] | None = None) -> MemHandle:
+        """Publish a buffer for one-sided GETs.  The caller hands ownership
+        of ``value`` to the engine: it must be a private snapshot (the last
+        consumer may receive the buffer itself, not a copy)."""
         h = MemHandle(self.rank, value, refcount, on_drained)
         with self._mem_lock:
             self._mem[h.handle_id] = h
@@ -195,6 +198,7 @@ class InprocCommEngine(CommEngine):
         self._get_ids = itertools.count(1)
         self._barrier_seen: dict[int, set] = {}
         self._barrier_gen = 0
+        self._progress_lock = threading.Lock()
         self.tag_register(AM_TAG_GET_REQ, self._serve_get)
         self.tag_register(AM_TAG_GET_REPLY, self._finish_get)
         self.tag_register(AM_TAG_BARRIER, self._on_barrier)
@@ -223,8 +227,10 @@ class InprocCommEngine(CommEngine):
             raise RuntimeError(
                 f"rank {self.rank}: GET for unknown handle {msg['handle']}")
         value = h.value
-        # the DMA copy: the receiver must own its bytes (ICI read analog)
-        if isinstance(value, np.ndarray):
+        # the DMA copy: the receiver must own its bytes (ICI read analog).
+        # The registered buffer is already a private snapshot, so the LAST
+        # consumer takes ownership of it instead of copying again.
+        if isinstance(value, np.ndarray) and h.refcount > 1:
             value = value.copy()
         self.send_am(AM_TAG_GET_REPLY, msg["reply_to"],
                      {"get_id": msg["get_id"], "value": value})
@@ -239,14 +245,22 @@ class InprocCommEngine(CommEngine):
         return self.fabric.pending(self.rank)
 
     def progress(self) -> int:
-        n = 0
-        for tag, src, payload in self.fabric.drain(self.rank):
-            cb = self._am_callbacks.get(tag)
-            if cb is None:
-                raise RuntimeError(f"no callback for AM tag {tag}")
-            cb(self, src, payload)
-            n += 1
-        return n
+        # funnelled discipline: idle workers, quiesce, and rank threads may
+        # all race here — only one thread drives the engine at a time, the
+        # rest skip (non-blocking) so AM callbacks never interleave
+        if not self._progress_lock.acquire(blocking=False):
+            return 0
+        try:
+            n = 0
+            for tag, src, payload in self.fabric.drain(self.rank):
+                cb = self._am_callbacks.get(tag)
+                if cb is None:
+                    raise RuntimeError(f"no callback for AM tag {tag}")
+                cb(self, src, payload)
+                n += 1
+            return n
+        finally:
+            self._progress_lock.release()
 
     def _on_barrier(self, eng: CommEngine, src: int, msg: dict) -> None:
         self._barrier_seen.setdefault(msg["gen"], set()).add(src)

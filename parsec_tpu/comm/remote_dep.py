@@ -220,7 +220,12 @@ class RemoteDepEngine:
                         nchildren = len(tree_children(
                             _params.get("comm_bcast_tree"), 0,
                             len(ranks) + 1))
-                        h = self.ce.mem_register(value, refcount=nchildren)
+                        # snapshot at registration: a local successor may
+                        # mutate the live tile in place before the remote GET
+                        # is served (the reference retains a refcounted data
+                        # copy for the whole send)
+                        h = self.ce.mem_register(value.copy(),
+                                                 refcount=nchildren)
                         desc["wire"] = h.wire()
                         desc["shape"] = value.shape
                         desc["dtype"] = str(value.dtype)
@@ -398,7 +403,9 @@ class RemoteDepEngine:
             fwd["outputs"] = [dict(d) for d in msg["outputs"]]
             for d in fwd["outputs"]:
                 if "wire" in d:
-                    value = np.asarray(landed[d["flow_index"]])
+                    # snapshot: the landed buffer is simultaneously handed to
+                    # local successors, which may mutate it in place
+                    value = np.asarray(landed[d["flow_index"]]).copy()
                     h = self.ce.mem_register(value, refcount=len(children))
                     d["wire"] = h.wire()
             self._send_to_children(tp, fwd, my_pos=my_pos)

@@ -38,6 +38,10 @@ def _all_keys(dc: DataCollection) -> list[tuple]:
                 if has(m, n)]
     if hasattr(dc, "mt"):
         return [(m,) for m in range(dc.mt)]
+    if hasattr(dc, "nodes"):
+        # non-tiled collections (DictCollection, hash distributions): one
+        # segment per node, keyed (r,)
+        return [(r,) for r in range(dc.nodes)]
     raise TypeError(f"cannot enumerate keys of {type(dc).__name__}")
 
 
@@ -147,8 +151,11 @@ def broadcast_taskpool(src: DataCollection, src_key: tuple,
     of the *destination* (``broadcast.jdf`` / Ex05 shape).  With multiple
     ranks the one-producer many-consumer flow rides the runtime's binomial
     propagation tree."""
-    p = ptg.PTGBuilder(name, SRC=src, DST=dst, KEY=src_key)
-    nodes = max(getattr(dst, "mt", dst.nodes), 1)
+    # enumerate the destination's full key space (works for 1-D vectors and
+    # 2-D tiled matrices alike); COPY tasks are indexed by position in it
+    dst_keys = _all_keys(dst)
+    p = ptg.PTGBuilder(name, SRC=src, DST=dst, KEY=src_key, DKEYS=dst_keys)
+    nodes = max(len(dst_keys), 1)
 
     w = p.task("ROOT", z=ptg.span(0, 0))
     w.affinity("SRC", lambda g, l: g.KEY)
@@ -159,12 +166,12 @@ def broadcast_taskpool(src: DataCollection, src_key: tuple,
     w.body(lambda es, task, g, l: None)
 
     t = p.task("COPY", r=ptg.span(0, nodes - 1))
-    t.affinity("DST", lambda g, l: (l.r,))
+    t.affinity("DST", lambda g, l: g.DKEYS[l.r])
     fx = t.flow("X", ptg.READ)
     fx.input(pred=("ROOT", "A", lambda g, l: {"z": 0}))
     fy = t.flow("Y", ptg.RW)
-    fy.input(data=("DST", lambda g, l: (l.r,)))
-    fy.output(data=("DST", lambda g, l: (l.r,)))
+    fy.input(data=("DST", lambda g, l: g.DKEYS[l.r]))
+    fy.output(data=("DST", lambda g, l: g.DKEYS[l.r]))
 
     def body(es, task, g, l):
         task.flow_data("Y").value[...] = np.asarray(

@@ -122,6 +122,20 @@ def test_broadcast_multi_segment_dst(ctx):
                                    np.arange(4.0))
 
 
+def test_broadcast_2d_dst(ctx):
+    """A 2-D tiled-matrix destination works (keys come from the collection's
+    own key space, not an assumed 1-D ``(r,)``)."""
+    src = VectorTwoDimCyclic("S3", lm=4, mb=2, P=1,
+                             init_fn=lambda m, size: np.full(size, 7.0))
+    dst = TiledMatrix.from_dense("D3", np.zeros((4, 4)), 2, 2)
+    ctx.add_taskpool(broadcast_taskpool(src, (0,), dst))
+    ctx.wait(timeout=30)
+    for i in range(2):
+        for j in range(2):
+            np.testing.assert_allclose(
+                dst.data_of(i, j).newest_copy().value, np.full((2, 2), 7.0))
+
+
 def _reduce_multirank_body(ctx, rank, nranks):
     n = 8
     a = np.arange(n * n, dtype=np.float64).reshape(n, n)
