@@ -21,41 +21,66 @@ import statistics
 import time
 
 
-def bench_gemm_gflops(n: int = 16384, reps: int = 16) -> dict:
-    """Steady-state GEMM throughput: a dependent chain of ``reps`` C += A·B
-    updates inside one program (repeated taskpool execution), synced by a
-    host scalar read (block_until_ready is unreliable through the TPU
-    tunnel; a read cannot complete before the compute does)."""
+def bench_gemm_gflops(n: int = 16384, nb: int = 512, reps: int = 48) -> dict:
+    """Steady-state throughput of the PTG tiled-GEMM taskpool, executed
+    through the framework's compiled incarnation: ``tiled_gemm_ptg`` builds
+    the GEMM(m,n,k) task graph, ``lower_taskpool`` collapses its k-chain to
+    one XLA contraction over the tile stores, and a dependent chain of
+    ``reps`` taskpool executions runs inside one program.  Synced by a host
+    scalar read (block_until_ready is unreliable through the TPU tunnel; a
+    read cannot complete before the compute does)."""
     import functools
 
     import jax
-    import jax.numpy as jnp
+    import numpy as np
+
+    from parsec_tpu.data_dist.matrix import TiledMatrix
+    from parsec_tpu.device.tpu import _flop_rating
+    from parsec_tpu.models.tiled_gemm import tiled_gemm_ptg
+    from parsec_tpu.ptg.lowering import lower_taskpool
 
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", "unknown")
-    from parsec_tpu.device.tpu import _flop_rating
     peak_bf16, _ = _flop_rating(kind.lower())
 
-    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), dtype=jnp.bfloat16)
-    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), dtype=jnp.bfloat16)
-    c0 = jnp.zeros((n, n), dtype=jnp.float32)
+    import jax.numpy as jnp
+    bf16 = np.dtype(jnp.bfloat16)
+
+    def mk(name, dtype):
+        def init(m, n_, shape):
+            rng = np.random.default_rng((hash((name, m, n_)) & 0x7FFFFFFF))
+            return rng.standard_normal(shape, dtype=np.float32).astype(dtype)
+        return TiledMatrix(name, n, n, nb, nb, dtype=dtype, init_fn=init)
+
+    A, B = mk("A", bf16), mk("B", bf16)
+    C = TiledMatrix("C", n, n, nb, nb, dtype=np.float32,
+                    init_fn=lambda m, n_, s: np.zeros(s, np.float32))
+
+    low = lower_taskpool(tiled_gemm_ptg(A, B, C))
+    assert low.mode == "chain-collapse", low.mode
+    stores = {k: jax.device_put(v, dev) for k, v in
+              low.initial_stores().items()}
+    step = low.step_fn
 
     @functools.partial(jax.jit, static_argnames=("reps",))
-    def chain(a, b, c, reps):
-        # the (zero) feedback of c into a makes each dot loop-carried, so
-        # XLA cannot hoist the matmul out of the scan as loop-invariant
-        def step(c, _):
-            a2 = a + (c[0:1, 0:1] * 0).astype(a.dtype)
-            return c + jnp.dot(a2, b, preferred_element_type=jnp.float32), None
-        c, _ = jax.lax.scan(step, c, None, length=reps)
-        return c
+    def chain(st, reps):
+        # the (zero) feedback of C into A makes each taskpool execution
+        # loop-carried, so XLA cannot hoist the contraction as invariant
+        def body(st, _):
+            # tiny in-place (DUS) perturbation instead of a full A+eps copy
+            eps = (st["C"].reshape(-1)[0] * 0).astype(st["A"].dtype)
+            st = dict(st)
+            st["A"] = st["A"].at[0, 0].add(eps)
+            return step(st), None
+        st, _ = jax.lax.scan(body, st, None, length=reps)
+        return st
 
-    _ = float(chain(a, b, c0, reps)[0, 0])  # compile + warm
+    _ = float(chain(stores, reps)["C"].reshape(-1)[0])  # compile + warm
     times = []
     for _i in range(3):
         t0 = time.perf_counter()
-        out = chain(a, b, c0, reps)
-        _sink = float(out[0, 0])
+        out = chain(stores, reps)
+        _sink = float(out["C"].reshape(-1)[0])
         times.append(time.perf_counter() - t0)
     t = statistics.median(times)
     gflops = 2.0 * n * n * n * reps / t / 1e9
@@ -65,8 +90,10 @@ def bench_gemm_gflops(n: int = 16384, reps: int = 16) -> dict:
         "pct_peak": 100.0 * gflops / peak_bf16,
         "device_kind": kind,
         "n": n,
+        "nb": nb,
         "reps": reps,
         "seconds": t,
+        "lowering": low.mode,
     }
 
 
@@ -111,8 +138,9 @@ def main() -> None:
             "pct_peak": round(gemm["pct_peak"], 2),
             "device_kind": gemm["device_kind"],
             "n": gemm["n"],
-            "nb": 512,
+            "nb": gemm["nb"],
             "gemm_seconds": round(gemm["seconds"], 4),
+            "lowering": gemm["lowering"],
             "task_dispatch_us": round(dispatch_us, 2),
         },
     }))

@@ -79,3 +79,29 @@ class DictCollection(DataCollection):
     def __contains__(self, key: tuple) -> bool:
         with self._lock:
             return key in self._store
+
+    def known_keys(self) -> list[tuple]:
+        """Keys materialized so far (a DictCollection has no a-priori key
+        space; operators enumerate what exists)."""
+        with self._lock:
+            return sorted(self._store)
+
+
+def enumerate_keys(dc: DataCollection) -> list[tuple]:
+    """Every *materialized* key of a collection with an enumerable key space:
+    tiled grids (``mt``/``nt``, minus storage holes via ``has_tile``), 1-D
+    segmented vectors (``mt``), or dict-backed collections' known keys.
+    The single source of truth shared by the operator taskpools and the
+    taskpool→XLA lowering."""
+    if hasattr(dc, "mt") and hasattr(dc, "nt"):
+        has = getattr(dc, "has_tile", lambda m, n: True)
+        return [(m, n) for m in range(dc.mt) for n in range(dc.nt)
+                if has(m, n)]
+    if hasattr(dc, "mt"):
+        return [(m,) for m in range(dc.mt)]
+    if isinstance(dc, DictCollection):
+        keys = dc.known_keys()
+        if keys:
+            return keys
+    raise TypeError(f"cannot enumerate keys of {type(dc).__name__} "
+                    f"{dc.name!r}")

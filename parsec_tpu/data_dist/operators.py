@@ -29,20 +29,7 @@ from .. import ptg
 from .collection import DataCollection
 
 
-def _all_keys(dc: DataCollection) -> list[tuple]:
-    """Enumerate every *materialized* tile key of a tiled collection (the
-    storage variant's ``has_tile`` filters symmetric/band holes)."""
-    if hasattr(dc, "mt") and hasattr(dc, "nt"):
-        has = getattr(dc, "has_tile", lambda m, n: True)
-        return [(m, n) for m in range(dc.mt) for n in range(dc.nt)
-                if has(m, n)]
-    if hasattr(dc, "mt"):
-        return [(m,) for m in range(dc.mt)]
-    if hasattr(dc, "nodes"):
-        # non-tiled collections (DictCollection, hash distributions): one
-        # segment per node, keyed (r,)
-        return [(r,) for r in range(dc.nodes)]
-    raise TypeError(f"cannot enumerate keys of {type(dc).__name__}")
+from .collection import enumerate_keys as _all_keys
 
 
 def map_taskpool(dc: DataCollection, fn: Callable[..., Any],

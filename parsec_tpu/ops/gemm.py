@@ -134,3 +134,21 @@ def gemm_cpu_body(es: Any, task: Any) -> Any:
 
 register_kernel("gemm", "tpu", gemm_tpu_body)
 register_kernel("gemm", "cpu", gemm_cpu_body)
+
+
+# ---------------------------------------------------------------------------
+# traceable incarnation: the same body as a pure jax function, consumed by
+# the taskpool→XLA lowering (parsec_tpu.ptg.lowering); bilinear=True lets
+# the chain-collapse pass turn the k-chain into one MXU-sized contraction
+# ---------------------------------------------------------------------------
+
+from ..ptg.lowering import register_traceable
+
+
+def _gemm_traceable(a: Any, b: Any, c: Any) -> Any:
+    acc = jnp.dot(a, b, preferred_element_type=jnp.float32,
+                  precision=_precision())
+    return (c.astype(jnp.float32) + acc).astype(c.dtype)
+
+
+register_traceable("gemm", _gemm_traceable, bilinear=True)

@@ -3,7 +3,10 @@
 from .dsl import (CTL, READ, RW, WRITE, FlowBuilder, PTGBuilder, PTGTaskpool,
                   TaskClassBuilder, span)
 from .jdf import JDF, JDFError, parse_jdf
+from .lowering import (LoweredTaskpool, LoweringError, find_traceable,
+                       lower_taskpool, register_traceable)
 
 __all__ = ["CTL", "READ", "RW", "WRITE", "FlowBuilder", "PTGBuilder",
            "PTGTaskpool", "TaskClassBuilder", "span", "JDF", "JDFError",
-           "parse_jdf"]
+           "parse_jdf", "LoweredTaskpool", "LoweringError", "find_traceable",
+           "lower_taskpool", "register_traceable"]

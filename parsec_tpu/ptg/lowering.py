@@ -1,0 +1,606 @@
+"""Taskpool→XLA lowering: compile a regular PTG dataflow to ONE jitted program.
+
+The reference executes every task through the dynamic scheduler; on TPU that
+host-dispatch loop caps MFU long before the MXU does.  The TPU-first answer
+(SURVEY §7 "design stance") is a *compilation step*: a PTG taskpool whose
+execution space and guards are regular is lowered — through the same
+chore/incarnation contract the dynamic path uses (``parsec_internal.h:396-402``)
+— into a single XLA program over stacked tile stores.  "Fused" is thereby a
+real incarnation of the taskpool, not a bypass: the input of this module is
+the *task graph itself* (classes, flows, guarded deps, kernel names), and the
+output is an executable the driver benches.
+
+Pipeline:
+
+1. **Analysis** — enumerate each class's execution space, evaluate guards
+   concretely, and build the full task DAG (the same information
+   ``iterate_successors`` walks at runtime, SURVEY §3.3).
+2. **Store allocation** — every referenced data collection becomes one
+   stacked device array ``[n_tiles, tile_h, tile_w]`` (tiles must be uniform;
+   ragged edges fall back to the dynamic runtime).
+3. **Chain-collapse pass** — the flagship optimization: a task class whose
+   RW flow forms a linear accumulation chain over one parameter, fed by two
+   READ flows with *factorized* keys (one ignores the chain's co-parameters
+   of the other), and whose kernel incarnation is declared **bilinear**
+   (``out = acc + lhs·rhs`` on tiles) collapses into one batched contraction
+   over the tile stores — the k-chain of GEMM(m,n,k) becomes a single
+   ``einsum('mkab,knbc->mnac')`` that XLA tiles onto the MXU at full size.
+4. **Unrolled dataflow fallback** — any other regular DAG is traced task by
+   task in topological order inside one jit; XLA fuses from there.
+
+Kernels participate by registering a *traceable incarnation* — a pure
+jax-traceable function of the flow values — next to their dynamic-path body
+(``register_traceable``; the ``dyld=`` name is shared, mirroring
+``find_incarnation``'s per-device dlsym, ``device_gpu.c:201``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable
+
+import numpy as np
+
+from ..data.data import ACCESS_RW, ACCESS_WRITE
+
+__all__ = ["LoweringError", "register_traceable", "find_traceable",
+           "lower_taskpool", "LoweredTaskpool"]
+
+
+class LoweringError(RuntimeError):
+    """Raised when a taskpool cannot be lowered (irregular structure,
+    non-traceable bodies, ragged tiles...).  Callers fall back to the
+    dynamic runtime — lowering is an optimization, never a requirement."""
+
+
+# ---------------------------------------------------------------------------
+# traceable-kernel registry (the compiled-incarnation side of ``dyld=``)
+# ---------------------------------------------------------------------------
+
+class Traceable:
+    """A jax-traceable incarnation of a task body.
+
+    ``apply(*flow_values) -> value | tuple`` receives the task's non-CTL flow
+    values in flow order and returns the new value(s) of its writable
+    (RW/WRITE) flows, in flow order.
+
+    ``bilinear=True`` declares tile-matmul semantics ``acc' = acc + lhs @
+    rhs`` (fp32 accumulate) — lhs/rhs being the class's two READ flows *in
+    declaration order* and acc its RW flow — enabling the chain-collapse
+    pass; ``chain_combine(lhs_stack, rhs_stack, acc0)`` may override the
+    default batched-einsum emission.
+    """
+
+    __slots__ = ("apply", "bilinear", "chain_combine")
+
+    def __init__(self, apply: Callable, bilinear: bool = False,
+                 chain_combine: Callable | None = None) -> None:
+        self.apply = apply
+        self.bilinear = bilinear
+        self.chain_combine = chain_combine or (
+            _default_bilinear_chain if bilinear else None)
+
+
+def _default_bilinear_chain(lhs: Any, rhs: Any, acc0: Any) -> Any:
+    """Collapse an accumulation chain: ``acc0[m,n] + sum_k lhs[m,k]·rhs[k,n]``
+    over tile stacks — one dot_general contracting (k, tile-k), which XLA
+    lays out as a full-size MXU matmul."""
+    import jax.numpy as jnp
+
+    acc = jnp.einsum("mkab,knbc->mnac", lhs, rhs,
+                     preferred_element_type=jnp.float32)
+    return (acc0.astype(jnp.float32) + acc).astype(acc0.dtype)
+
+
+_lock = threading.Lock()
+_traceables: dict[str, Traceable] = {}
+
+
+def register_traceable(name: str, apply: Callable, *, bilinear: bool = False,
+                       chain_combine: Callable | None = None) -> Traceable:
+    t = Traceable(apply, bilinear=bilinear, chain_combine=chain_combine)
+    with _lock:
+        _traceables[name] = t
+    return t
+
+
+def find_traceable(name: str) -> Traceable | None:
+    with _lock:
+        return _traceables.get(name)
+
+
+# ---------------------------------------------------------------------------
+# analysis
+# ---------------------------------------------------------------------------
+
+class _ClassInfo:
+    __slots__ = ("tc", "tasks", "kernel", "data_flows", "writable_flows")
+
+    def __init__(self, tc, tasks, kernel):
+        self.tc = tc
+        self.tasks = tasks              # list[dict] locals, enumeration order
+        self.kernel = kernel            # Traceable | None
+        self.data_flows = [f for f in tc.flows if not f.is_ctl]
+        self.writable_flows = [f for f in self.data_flows
+                               if f.access in (ACCESS_RW, ACCESS_WRITE)]
+
+
+def _class_kernel(tc) -> Traceable | None:
+    for chore in tc.chores:
+        if chore.dyld is not None:
+            t = find_traceable(chore.dyld)
+            if t is not None:
+                return t
+    return None
+
+
+def _analyze(tp) -> dict[str, _ClassInfo]:
+    infos: dict[str, _ClassInfo] = {}
+    for tc in tp.task_classes:
+        tcb = tp._tc_builders[tc.name]
+        tasks = list(tcb._enumerate_space())
+        kernel = _class_kernel(tc)
+        if kernel is None and any(not f.is_ctl for f in tc.flows):
+            raise LoweringError(
+                f"task class {tc.name} has data flows but no traceable "
+                f"kernel incarnation (register_traceable under its dyld name)")
+        infos[tc.name] = _ClassInfo(tc, tasks, kernel)
+    return infos
+
+
+def _collection_keys(dc) -> list[tuple]:
+    from ..data_dist.collection import enumerate_keys
+    try:
+        return enumerate_keys(dc)
+    except TypeError as e:
+        raise LoweringError(str(e))
+
+
+def _norm_key(key) -> tuple:
+    return key if isinstance(key, tuple) else (key,)
+
+
+class _Stores:
+    """One device array per referenced collection.
+
+    Layout per collection is chosen by the lowering passes: ``stacked``
+    (``[n_tiles, h, w]``, supports arbitrary gathers) or ``dense`` (the
+    whole matrix ``[lm, ln]``, chosen when a pass proves its accesses form
+    the identity tile grid — the fused program then reads the operand in
+    its natural layout with zero gather/relayout cost)."""
+
+    def __init__(self):
+        self.dcs: dict[str, Any] = {}
+        self.rows: dict[str, dict[tuple, int]] = {}
+        self.written: set[str] = set()
+        self.layout: dict[str, str] = {}
+
+    def row(self, dc, key: tuple) -> int:
+        name = dc.name
+        if name not in self.dcs:
+            keys = _collection_keys(dc)
+            shapes = {dc.tile_shape(*k) if hasattr(dc, "tile_shape")
+                      else np.asarray(dc.data_of(*k).newest_copy().value).shape
+                      for k in keys}
+            if len(shapes) != 1:
+                raise LoweringError(
+                    f"collection {name} has ragged tiles {shapes}; "
+                    f"lowering needs uniform tile shapes")
+            self.dcs[name] = dc
+            self.rows[name] = {k: i for i, k in enumerate(keys)}
+            self.layout[name] = "stacked"
+        try:
+            return self.rows[name][key]
+        except KeyError:
+            raise LoweringError(f"{name}: key {key} outside the store")
+
+    def is_dense_grid(self, dc, I: np.ndarray) -> bool:
+        """Whether index grid ``I`` is exactly the identity tile grid of the
+        whole collection: ``I[i, j] == row of tile (i, j)``, every tile
+        covered.  Pure check; commit with ``set_dense``."""
+        name = dc.name
+        if not (hasattr(dc, "mt") and hasattr(dc, "nt")):
+            return False
+        if I.shape != (dc.mt, dc.nt):
+            return False
+        if len(self.rows[name]) != dc.mt * dc.nt:
+            return False
+        expect = np.array([[self.rows[name][(m, n)] for n in range(dc.nt)]
+                           for m in range(dc.mt)], I.dtype)
+        return bool(np.array_equal(I, expect))
+
+    def set_dense(self, dc) -> None:
+        self.layout[dc.name] = "dense"
+
+    def materialize(self) -> dict[str, Any]:
+        """Gather tiles into host arrays (device placement is the caller's
+        business — jit will device_put on first call)."""
+        out = {}
+        for name, dc in self.dcs.items():
+            if self.layout[name] == "dense":
+                out[name] = dc.to_dense()
+                continue
+            keys = sorted(self.rows[name], key=self.rows[name].get)
+            tiles = [np.asarray(dc.data_of(*k).newest_copy().value)
+                     for k in keys]
+            out[name] = np.stack(tiles)
+        return out
+
+    def writeback(self, values: dict[str, Any]) -> None:
+        for name in self.written:
+            dc = self.dcs[name]
+            arr = np.asarray(values[name])
+            for key, i in self.rows[name].items():
+                copy = dc.data_of(*key).newest_copy()
+                # per-tile host copies: np.asarray over a jax array yields
+                # read-only views, and task bodies mutate tiles in place
+                if self.layout[name] == "dense":
+                    m, n = key
+                    copy.value = np.array(arr[m * dc.mb:(m + 1) * dc.mb,
+                                              n * dc.nb:(n + 1) * dc.nb])
+                else:
+                    copy.value = np.array(arr[i])
+                copy.version += 1
+
+
+# ---------------------------------------------------------------------------
+# pass 1: bilinear chain collapse
+# ---------------------------------------------------------------------------
+
+def _active_in_deps(flow, locals_):
+    return [d for d in flow.deps_in if d.active(locals_)]
+
+
+def _active_out_deps(flow, locals_):
+    return [d for d in flow.deps_out if d.active(locals_)]
+
+
+def _key_param_deps(tasks: list[dict], keys: list[tuple],
+                    params: list[str]) -> set[str]:
+    """Which params influence ``key`` — decided concretely: q matters iff two
+    tasks differing only in q have different keys."""
+    deps: set[str] = set()
+    for q in params:
+        rest = [p for p in params if p != q]
+        seen: dict[tuple, Any] = {}
+        for loc, key in zip(tasks, keys):
+            r = tuple(loc[p] for p in rest)
+            if r in seen and seen[r] != key:
+                deps.add(q)
+                break
+            seen.setdefault(r, key)
+    return deps
+
+
+def _try_chain_collapse(tp, infos, stores: _Stores):
+    """Detect ``ACC(p..., k)``: init-from-store at k=lo, accumulate lhs·rhs
+    along k, write-to-store at k=hi — and emit one contraction."""
+    if len(infos) != 1:
+        return None
+    (info,) = infos.values()
+    tc, kernel, tasks = info.tc, info.kernel, info.tasks
+    if kernel is None or not kernel.bilinear or not tasks:
+        return None
+    if len(info.data_flows) != 3 or len(info.writable_flows) != 1:
+        return None
+    acc = info.writable_flows[0]
+    lhs, rhs = [f for f in info.data_flows if f is not acc]
+    params = tc.params
+
+    # -- identify the chain parameter from any interior pred edge ------------
+    chain = None
+    for loc in tasks:
+        for d in _active_in_deps(acc, loc):
+            if d.target_class == tc.name and d.target_flow == acc.name:
+                pred = d.target_params(loc)
+                diff = [p for p in params if pred[p] != loc[p]]
+                if len(diff) == 1 and loc[diff[0]] - pred[diff[0]] == 1:
+                    chain = diff[0]
+                break
+        if chain:
+            break
+    if chain is None:
+        return None
+
+    kvals = sorted({loc[chain] for loc in tasks})
+    if kvals != list(range(kvals[0], kvals[-1] + 1)):
+        return None
+    klo, khi = kvals[0], kvals[-1]
+
+    # -- verify the chain structure concretely on every task -----------------
+    lhs_keys, rhs_keys, acc_keys = [], [], []
+    for loc in tasks:
+        li = _active_in_deps(lhs, loc)
+        ri = _active_in_deps(rhs, loc)
+        ai = _active_in_deps(acc, loc)
+        ao = _active_out_deps(acc, loc)
+        if len(li) != 1 or li[0].data_ref is None:
+            return None
+        if len(ri) != 1 or ri[0].data_ref is None:
+            return None
+        if _active_out_deps(lhs, loc) or _active_out_deps(rhs, loc):
+            return None
+        if len(ai) != 1:
+            return None
+        if loc[chain] == klo:
+            if ai[0].data_ref is None:
+                return None
+        else:
+            d = ai[0]
+            if (d.target_class != tc.name or d.target_flow != acc.name):
+                return None
+            pred = d.target_params(loc)
+            if any(pred[p] != (loc[p] - (p == chain)) for p in params):
+                return None
+        succ = [d for d in ao if d.target_class == tc.name
+                and d.target_flow == acc.name]
+        data_out = [d for d in ao if d.data_ref is not None]
+        if loc[chain] < khi:
+            if len(succ) != 1 or data_out:
+                return None
+            nxt = succ[0].target_params(loc)
+            if any(nxt[p] != (loc[p] + (p == chain)) for p in params):
+                return None
+        else:
+            if succ or len(data_out) != 1:
+                return None
+        lhs_keys.append((li[0].data_ref(loc)))
+        rhs_keys.append((ri[0].data_ref(loc)))
+        if loc[chain] == klo:
+            acc_keys.append(ai[0].data_ref(loc))
+        elif loc[chain] == khi:
+            acc_keys.append(data_out[0].data_ref(loc))
+        else:
+            acc_keys.append(None)
+
+    # -- factorization: lhs depends on (Pl, chain), rhs on (Pr, chain) -------
+    lk = [_norm_key(k) for _, k in lhs_keys]
+    rk = [_norm_key(k) for _, k in rhs_keys]
+    free = [p for p in params if p != chain]
+    ldeps = _key_param_deps(tasks, lk, params) - {chain}
+    rdeps = _key_param_deps(tasks, rk, params) - {chain}
+    if ldeps & rdeps or (ldeps | rdeps) != set(free):
+        return None
+    pl = sorted(ldeps, key=params.index)
+    pr = sorted(rdeps, key=params.index)
+
+    mvals = sorted({tuple(loc[p] for p in pl) for loc in tasks})
+    nvals = sorted({tuple(loc[p] for p in pr) for loc in tasks})
+    if len(tasks) != len(mvals) * len(nvals) * len(kvals):
+        return None    # not a dense product space
+
+    lhs_dc = lhs_keys[0][0]
+    rhs_dc = rhs_keys[0][0]
+    acc_dc = next(k for k in acc_keys if k is not None)[0]
+    # every edge of a flow must read one single collection — a guarded
+    # multi-collection input cannot collapse onto one store gather
+    if any(dc is not lhs_dc for dc, _ in lhs_keys):
+        return None
+    if any(dc is not rhs_dc for dc, _ in rhs_keys):
+        return None
+    if any(k is not None and k[0] is not acc_dc for k in acc_keys):
+        return None
+    mi = {v: i for i, v in enumerate(mvals)}
+    ni = {v: i for i, v in enumerate(nvals)}
+    ki = {v: i for i, v in enumerate(kvals)}
+    IA = np.zeros((len(mvals), len(kvals)), np.int32)
+    IB = np.zeros((len(kvals), len(nvals)), np.int32)
+    IC = np.full((len(mvals), len(nvals)), -1, np.int32)
+    for loc, lkey, rkey, akey in zip(tasks, lk, rk, acc_keys):
+        m = mi[tuple(loc[p] for p in pl)]
+        n = ni[tuple(loc[p] for p in pr)]
+        k = ki[loc[chain]]
+        IA[m, k] = stores.row(lhs_dc, lkey)
+        IB[k, n] = stores.row(rhs_dc, rkey)
+        if akey is not None:
+            row = stores.row(acc_dc, _norm_key(akey[1]))
+            if IC[m, n] not in (-1, row):
+                return None    # init and final writeback rows must agree
+            IC[m, n] = row
+    if (IC < 0).any():
+        return None
+    stores.written.add(acc_dc.name)
+
+    combine = kernel.chain_combine
+    an, bn, cn = lhs_dc.name, rhs_dc.name, acc_dc.name
+
+    # -- layout selection: identity tile grids lower to dense operands -------
+    # The contraction then reads each matrix in its natural [lm, ln] layout
+    # and the emitted program is exactly ``C = tile_body(A, B, C)`` on dense
+    # operands — zero gather/relayout traffic on the hot path.
+    if (len({an, bn, cn}) == 3
+            and stores.is_dense_grid(lhs_dc, IA)
+            and stores.is_dense_grid(rhs_dc, IB)
+            and stores.is_dense_grid(acc_dc, IC)):
+        for dc in (lhs_dc, rhs_dc, acc_dc):
+            stores.set_dense(dc)
+        apply = kernel.apply
+        # apply's contract is "flow values in declaration order" — respect
+        # it even when the RW flow is not declared last
+        arg_names = [{id(lhs): an, id(rhs): bn, id(acc): cn}[id(f)]
+                     for f in info.data_flows]
+
+        def step_fn(st: dict) -> dict:
+            st = dict(st)
+            st[cn] = apply(*(st[nm] for nm in arg_names))
+            return st
+
+        return step_fn
+
+    IC_flat = IC.reshape(-1)
+
+    def step_fn(st: dict) -> dict:
+        a = st[an][IA]                      # [M, K, ta, tk]
+        b = st[bn][IB]                      # [K, N, tk, tb]
+        c0 = st[cn][IC]                     # [M, N, ta, tb]
+        c = combine(a, b, c0)
+        st = dict(st)
+        st[cn] = st[cn].at[IC_flat].set(c.reshape(-1, *c.shape[2:]))
+        return st
+
+    return step_fn
+
+
+# ---------------------------------------------------------------------------
+# pass 2: generic unrolled dataflow (topological trace)
+# ---------------------------------------------------------------------------
+
+def _topo_order(tp, infos) -> list[tuple[str, int]]:
+    """Kahn's ordering over the concrete task DAG (CTL edges count)."""
+    index: dict[tuple[str, tuple], tuple[str, int]] = {}
+    for cname, info in infos.items():
+        for i, loc in enumerate(info.tasks):
+            index[(cname, info.tc.make_key(loc))] = (cname, i)
+    indeg = {v: 0 for v in index.values()}
+    succs: dict[tuple[str, int], list] = {v: [] for v in index.values()}
+    for cname, info in infos.items():
+        for i, loc in enumerate(info.tasks):
+            for f in info.tc.flows:
+                for d in f.deps_out:
+                    if d.target_class is None or not d.active(loc):
+                        continue
+                    tgt_tc = tp.task_class(d.target_class)
+                    tgt_loc = d.target_params(loc)
+                    tgt = index.get((d.target_class, tgt_tc.make_key(tgt_loc)))
+                    if tgt is None:
+                        raise LoweringError(
+                            f"{cname}{info.tc.make_key(loc)} -> missing "
+                            f"successor {d.target_class}({tgt_loc})")
+                    succs[(cname, i)].append(tgt)
+                    indeg[tgt] += 1
+    ready = [v for v, n in indeg.items() if n == 0]
+    out = []
+    while ready:
+        v = ready.pop()
+        out.append(v)
+        for s in succs[v]:
+            indeg[s] -= 1
+            if indeg[s] == 0:
+                ready.append(s)
+    if len(out) != len(indeg):
+        raise LoweringError("task graph has a cycle")
+    return out
+
+
+def _build_unrolled(tp, infos, stores: _Stores):
+    order = _topo_order(tp, infos)
+
+    # precompute, per task, its input plan and output plan (host side)
+    plans = []
+    for cname, i in order:
+        info = infos[cname]
+        tc, loc = info.tc, info.tasks[i]
+        key = tc.make_key(loc)
+        in_plan = []        # per data flow: ("store", name, row) | ("val", ck)
+        for f in info.data_flows:
+            deps = _active_in_deps(f, loc)
+            if len(deps) != 1:
+                raise LoweringError(
+                    f"{cname}{key} flow {f.name}: expected exactly one "
+                    f"active input dep, got {len(deps)}")
+            d = deps[0]
+            if d.data_ref is not None:
+                dc, k = d.data_ref(loc)
+                in_plan.append(("store", dc.name, stores.row(dc, _norm_key(k))))
+            else:
+                ptc = tp.task_class(d.target_class)
+                pkey = ptc.make_key(d.target_params(loc))
+                pfi = next(ff.flow_index for ff in ptc.flows
+                           if ff.name == d.target_flow)
+                in_plan.append(("val", (d.target_class, pkey, pfi)))
+        out_plan = []       # per data flow: list of store rows to scatter
+        for f in info.data_flows:
+            rows = []
+            for d in _active_out_deps(f, loc):
+                if d.data_ref is not None:
+                    dc, k = d.data_ref(loc)
+                    rows.append((dc.name, stores.row(dc, _norm_key(k))))
+                    stores.written.add(dc.name)
+            out_plan.append(rows)
+        plans.append((cname, key, info, in_plan, out_plan))
+
+    def step_fn(st: dict) -> dict:
+        st = dict(st)
+        vals: dict[tuple, Any] = {}
+        for cname, key, info, in_plan, out_plan in plans:
+            args = []
+            for kind, *ref in in_plan:
+                if kind == "store":
+                    name, row = ref
+                    args.append(st[name][row])
+                else:
+                    args.append(vals[ref[0]])
+            if info.kernel is not None and args:
+                res = info.kernel.apply(*args)
+                if not isinstance(res, tuple):
+                    res = (res,)
+                wi = {f.flow_index: j
+                      for j, f in enumerate(info.writable_flows)}
+            else:
+                res, wi = (), {}
+            for f, rows in zip(info.data_flows, out_plan):
+                v = (res[wi[f.flow_index]] if f.flow_index in wi
+                     else args[info.data_flows.index(f)])
+                vals[(cname, key, f.flow_index)] = v
+                for name, row in rows:
+                    st[name] = st[name].at[row].set(v)
+        return st
+
+    return step_fn
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+class LoweredTaskpool:
+    """A compiled incarnation of a PTG taskpool.
+
+    ``step_fn``: pure function ``{collection_name: stacked tiles} -> same`` —
+    one full taskpool execution; jit it, scan it, shard it.
+    ``execute()``: convenience — run once on device and write tiles back to
+    the source collections (the dynamic path's completion semantics).
+    """
+
+    def __init__(self, tp, step_fn, stores: _Stores, mode: str) -> None:
+        self.taskpool = tp
+        self.step_fn = step_fn
+        self._stores = stores
+        self.mode = mode    # "chain-collapse" | "unrolled"
+        self._jitted = None
+
+    def initial_stores(self) -> dict[str, Any]:
+        return self._stores.materialize()
+
+    @property
+    def written_collections(self) -> set[str]:
+        return set(self._stores.written)
+
+    def execute(self) -> dict[str, Any]:
+        import jax
+        if self._jitted is None:
+            self._jitted = jax.jit(self.step_fn)
+        out = self._jitted(self.initial_stores())
+        self._stores.writeback(out)
+        return out
+
+
+def lower_taskpool(tp, context: Any = None) -> LoweredTaskpool:
+    """Lower a regular PTG taskpool to one XLA program.
+
+    Raises :class:`LoweringError` when the structure is not lowerable; the
+    caller then runs the dynamic scheduler instead (same taskpool object).
+    """
+    if context is not None and getattr(context, "nb_ranks", 1) > 1:
+        raise LoweringError("multi-rank lowering goes through shard_map "
+                            "(parsec_tpu.parallel); dynamic path here")
+    infos = _analyze(tp)
+    stores = _Stores()
+    step = _try_chain_collapse(tp, infos, stores)
+    mode = "chain-collapse"
+    if step is None:
+        stores = _Stores()
+        step = _build_unrolled(tp, infos, stores)
+        mode = "unrolled"
+    return LoweredTaskpool(tp, step, stores, mode)
