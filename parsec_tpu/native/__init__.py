@@ -1,0 +1,239 @@
+"""ctypes bindings for the native runtime core (``src/core.cpp``).
+
+The dispatch hot-path structures of the foundation tier (SURVEY §2.1) in
+C++ behind a C ABI: the ABA-counted lock-free LIFO (``class/lifo.h``
+analog), the spinlocked dequeue and maxheap, the hashed dependency table
+implementing the satisfied-mask protocol (``parsec_update_deps_with_mask``,
+``parsec.c:1577``), and the zero-detecting atomic counter
+(``parsec_internal.h:124-144`` discipline).
+
+``ensure_built()`` compiles the shared library on demand (cached under
+``build/``, rebuilt when the source is newer).  Loading is best-effort: when
+no toolchain is available the runtime falls back to the pure-Python
+structures, controlled by the ``runtime_native`` MCA param.
+
+Integration points:
+
+- :mod:`parsec_tpu.runtime.deps` keys the native dep table with an exact
+  (injective) 64-bit packing of (taskpool, class, params) when the task
+  shape fits, falling back per-key to the Python tracker otherwise;
+- the ``ll``/``llp`` schedulers back their per-stream queues with
+  :class:`NativeLifo` when available (the reference's ll *is* its lock-free
+  LIFO).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Any
+
+from ..core.params import params as _params
+
+_params.register("runtime_native", True,
+                 "use the native (C++) dep table / queues when buildable")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "core.cpp")
+_SO = os.path.join(_HERE, "build", "libparsec_tpu_native.so")
+
+_lock = threading.Lock()
+_lib: Any = None
+_tried = False
+
+
+def ensure_built(force: bool = False) -> str | None:
+    """Compile ``core.cpp`` → ``build/libparsec_tpu_native.so`` if stale.
+    Returns the library path, or None when the build fails."""
+    try:
+        if (not force and os.path.exists(_SO)
+                and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+            return _SO
+        os.makedirs(os.path.dirname(_SO), exist_ok=True)
+        cmd = ["g++", "-O3", "-fPIC", "-std=c++17", "-Wall", "-mcx16",
+               "-pthread", "-shared", "-o", _SO, _SRC, "-latomic"]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except Exception:
+        return None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u64, i64, vp = ctypes.c_uint64, ctypes.c_int64, ctypes.c_void_p
+    pu64 = ctypes.POINTER(ctypes.c_uint64)
+    sigs = {
+        "pt_lifo_new": ([], vp),
+        "pt_lifo_free": ([vp], None),
+        "pt_lifo_push": ([vp, u64], None),
+        "pt_lifo_pop": ([vp, pu64], ctypes.c_int),
+        "pt_lifo_size": ([vp], ctypes.c_long),
+        "pt_deque_new": ([], vp),
+        "pt_deque_free": ([vp], None),
+        "pt_deque_push_back": ([vp, u64], None),
+        "pt_deque_push_front": ([vp, u64], None),
+        "pt_deque_pop_front": ([vp, pu64], ctypes.c_int),
+        "pt_deque_pop_back": ([vp, pu64], ctypes.c_int),
+        "pt_deque_size": ([vp], ctypes.c_long),
+        "pt_heap_new": ([], vp),
+        "pt_heap_free": ([vp], None),
+        "pt_heap_push": ([vp, i64, u64], None),
+        "pt_heap_pop": ([vp, pu64], ctypes.c_int),
+        "pt_heap_size": ([vp], ctypes.c_long),
+        "pt_deptable_new": ([u64], vp),
+        "pt_deptable_free": ([vp], None),
+        "pt_deptable_release": ([vp, u64, u64, u64], ctypes.c_int),
+        "pt_deptable_count": ([vp], ctypes.c_long),
+        "pt_counter_new": ([i64], vp),
+        "pt_counter_free": ([vp], None),
+        "pt_counter_add": ([vp, i64], i64),
+        "pt_counter_get": ([vp], i64),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    return lib
+
+
+def load() -> Any:
+    """The loaded library, or None when not buildable.  The
+    ``runtime_native`` MCA param is enforced at the integration points
+    (dep tracking, schedulers), not here."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        so = ensure_built()
+        if so is None:
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(so))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class _Handle:
+    """Owns one native object; frees it on GC."""
+
+    __slots__ = ("_lib", "_h", "_free")
+
+    def __init__(self, lib, h, free_name: str) -> None:
+        self._lib = lib
+        self._h = h
+        self._free = getattr(lib, free_name)
+
+    def __del__(self):
+        h, self._h = self._h, None
+        if h:
+            try:
+                self._free(h)
+            except Exception:
+                pass
+
+
+class NativeLifo(_Handle):
+    def __init__(self) -> None:
+        lib = load()
+        super().__init__(lib, lib.pt_lifo_new(), "pt_lifo_free")
+
+    def push(self, value: int) -> None:
+        self._lib.pt_lifo_push(self._h, value)
+
+    def pop(self) -> int | None:
+        out = ctypes.c_uint64()   # per-call: ctypes drops the GIL
+        if self._lib.pt_lifo_pop(self._h, ctypes.byref(out)):
+            return out.value
+        return None
+
+    def __len__(self) -> int:
+        return self._lib.pt_lifo_size(self._h)
+
+
+class NativeDeque(_Handle):
+    def __init__(self) -> None:
+        lib = load()
+        super().__init__(lib, lib.pt_deque_new(), "pt_deque_free")
+
+    def push_back(self, v: int) -> None:
+        self._lib.pt_deque_push_back(self._h, v)
+
+    def push_front(self, v: int) -> None:
+        self._lib.pt_deque_push_front(self._h, v)
+
+    def pop_front(self) -> int | None:
+        out = ctypes.c_uint64()   # per-call: ctypes drops the GIL
+        if self._lib.pt_deque_pop_front(self._h, ctypes.byref(out)):
+            return out.value
+        return None
+
+    def pop_back(self) -> int | None:
+        out = ctypes.c_uint64()   # per-call: ctypes drops the GIL
+        if self._lib.pt_deque_pop_back(self._h, ctypes.byref(out)):
+            return out.value
+        return None
+
+    def __len__(self) -> int:
+        return self._lib.pt_deque_size(self._h)
+
+
+class NativeHeap(_Handle):
+    def __init__(self) -> None:
+        lib = load()
+        super().__init__(lib, lib.pt_heap_new(), "pt_heap_free")
+
+    def push(self, priority: int, v: int) -> None:
+        self._lib.pt_heap_push(self._h, priority, v)
+
+    def pop(self) -> int | None:
+        out = ctypes.c_uint64()   # per-call: ctypes drops the GIL
+        if self._lib.pt_heap_pop(self._h, ctypes.byref(out)):
+            return out.value
+        return None
+
+    def __len__(self) -> int:
+        return self._lib.pt_heap_size(self._h)
+
+
+class NativeDepTable(_Handle):
+    """key64 -> {required, satisfied} with removal-on-ready.
+
+    ``release`` returns 1 when the key just became ready, 0 otherwise and
+    raises on a double-set bit (the PARSEC_DEBUG_PARANOID assert)."""
+
+    def __init__(self, nbuckets: int = 1 << 14) -> None:
+        lib = load()
+        super().__init__(lib, lib.pt_deptable_new(nbuckets),
+                         "pt_deptable_free")
+        self._release = lib.pt_deptable_release   # bound-method cache
+
+    def release(self, key64: int, bits: int, required_mask: int) -> bool:
+        rc = self._release(self._h, key64, bits, required_mask)
+        if rc < 0:
+            raise AssertionError(
+                f"dep key {key64:#x}: bits {bits:#x} satisfied twice")
+        return bool(rc)
+
+    def __len__(self) -> int:
+        return self._lib.pt_deptable_count(self._h)
+
+
+class NativeCounter(_Handle):
+    def __init__(self, init: int = 0) -> None:
+        lib = load()
+        super().__init__(lib, lib.pt_counter_new(init), "pt_counter_free")
+
+    def add(self, delta: int) -> int:
+        return self._lib.pt_counter_add(self._h, delta)
+
+    def get(self) -> int:
+        return self._lib.pt_counter_get(self._h)

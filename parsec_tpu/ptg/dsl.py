@@ -50,6 +50,29 @@ def _ns(d: dict) -> _NS:
     return _NS(**d)
 
 
+class _DictNS:
+    """Live attribute view over a dict (globals namespace, hot path: built
+    once per builder; later mutations of the dict are visible)."""
+
+    __slots__ = ("_d",)
+
+    def __init__(self, d: dict) -> None:
+        object.__setattr__(self, "_d", d)
+
+    def __getattr__(self, k):
+        try:
+            return self._d[k]
+        except KeyError:
+            raise AttributeError(k)
+
+    def __getitem__(self, k):
+        return self._d[k]
+
+    @property
+    def __dict__(self):   # vars(g) support (the JDF expression evaluator)
+        return self._d
+
+
 class FlowBuilder:
     def __init__(self, tcb: "TaskClassBuilder", name: str, access: Any,
                  dtt: Any = None) -> None:
@@ -275,13 +298,14 @@ class PTGBuilder:
         self.name = name
         self.globals = dict(globals_)
         self._classes: list[TaskClassBuilder] = []
+        self._g_view = _DictNS(self.globals)
 
     def global_(self, **kw) -> "PTGBuilder":
         self.globals.update(kw)
         return self
 
-    def _g_ns(self) -> _NS:
-        return _ns(self.globals)
+    def _g_ns(self) -> _DictNS:
+        return self._g_view   # live view: global updates stay visible
 
     def _dc_getter(self, collection: Any) -> Callable[[], Any]:
         if isinstance(collection, str):

@@ -20,6 +20,26 @@ from typing import Any
 from ..core.hash_table import ConcurrentHashTable
 from .task import Task, TaskClass
 
+# 64-bit key layout for the native dep table: [tpid:10][tcid:6][params:48].
+# Packing is *exact* (injective) or refused — a non-packable key falls back
+# to the Python tracker for that task, never to a lossy hash.
+_TP_BITS, _TC_BITS, _PARAM_BITS = 10, 6, 48
+
+
+def _pack_key64(tpid: int, tcid: int, key: tuple) -> int | None:
+    if tpid >= (1 << _TP_BITS) or tcid >= (1 << _TC_BITS):
+        return None
+    v = 0
+    p = len(key)
+    if p:
+        bits = _PARAM_BITS // p
+        lim = 1 << bits
+        for x in key:
+            if type(x) is not int or x < 0 or x >= lim:
+                return None
+            v = (v << bits) | x
+    return (tpid << (_TC_BITS + _PARAM_BITS)) | (tcid << _PARAM_BITS) | v
+
 
 class _DepTracker:
     __slots__ = ("required_mask", "satisfied_mask", "inputs", "repo_refs",
@@ -34,10 +54,28 @@ class _DepTracker:
 
 
 class DependencyTracking:
-    """One instance per taskpool (cf. per-task-class ``parsec_dependencies_t``)."""
+    """One instance per context (cf. per-task-class ``parsec_dependencies_t``).
+
+    Two storage tiers share one protocol: the **native** C++ dep table
+    (mask bookkeeping behind one atomic call, keyed by an exact 64-bit
+    packing of the task identity) and the **Python** tracker table (any key
+    shape).  Data-carrying deps stash their input copies in a side dict
+    either way; the pure-CTL hot path (the dispatch benchmark's EP DAG)
+    never touches Python locks with the native tier on.
+    """
 
     def __init__(self) -> None:
         self._table = ConcurrentHashTable()
+        self._native = None
+        self._inputs: dict[int, list] = {}    # k64 -> inputs ++ repo_refs
+        self._inputs_lock = threading.Lock()
+        try:
+            from .. import native            # registers runtime_native
+            from ..core.params import params as _params
+            if _params.get("runtime_native") and native.available():
+                self._native = native.NativeDepTable()
+        except Exception:
+            self._native = None
 
     def release_dep(self, taskpool: Any, tc: TaskClass, locals_: dict,
                     flow_index: int, dep_index: int,
@@ -47,8 +85,15 @@ class DependencyTracking:
         ``repo_ref`` is (repo_entry, src_flow_index) for usage accounting at
         completion (``jdf2c.c:7157`` consume-input-repos contract).
         """
-        key = (taskpool.taskpool_id, tc.task_class_id, tc.make_key(locals_))
+        tkey = tc.make_key(locals_)
         bit = 1 << tc.dep_bit(flow_index, dep_index)
+        if self._native is not None:
+            k64 = _pack_key64(taskpool.taskpool_id, tc.task_class_id, tkey)
+            if k64 is not None:
+                return self._release_native(taskpool, tc, locals_, tkey, k64,
+                                            bit, flow_index, data_copy,
+                                            repo_ref)
+        key = (taskpool.taskpool_id, tc.task_class_id, tkey)
         with self._table.locked(key):
             trk = self._table.get(key)
             if trk is None:
@@ -66,14 +111,50 @@ class DependencyTracking:
                 self._table.remove(key)
         if not ready:
             return None
+        return self._make_ready(taskpool, tc, locals_, trk.inputs,
+                                trk.repo_refs)
+
+    def _release_native(self, taskpool: Any, tc: TaskClass, locals_: dict,
+                        tkey: tuple, k64: int, bit: int, flow_index: int,
+                        data_copy: Any, repo_ref: Any) -> Task | None:
+        # inputs are written BEFORE the native release: the releaser that
+        # observes readiness sees every earlier writer's entry (GIL + the
+        # table's internal lock order the accesses)
+        if data_copy is not None:
+            with self._inputs_lock:
+                lst = self._inputs.get(k64)
+                if lst is None:
+                    lst = self._inputs[k64] = [None] * (2 * len(tc.flows))
+                lst[flow_index] = data_copy
+                lst[len(tc.flows) + flow_index] = repo_ref
+        if not self._native.release(k64, bit, tc.input_dep_mask(locals_)):
+            return None
+        with self._inputs_lock:
+            lst = self._inputs.pop(k64, None)
+        if lst is None:
+            nf = len(tc.flows)
+            return self._make_ready(taskpool, tc, locals_,
+                                    [None] * nf, [None] * nf)
+        nf = len(tc.flows)
+        return self._make_ready(taskpool, tc, locals_, lst[:nf], lst[nf:])
+
+    def _make_ready(self, taskpool: Any, tc: TaskClass, locals_: dict,
+                    inputs: list, repo_refs: list) -> Task:
         prio = tc.priority(locals_) if tc.priority is not None else 0
         task = Task(taskpool, tc, dict(locals_), priority=prio)
-        task.data = list(trk.inputs)
-        task.repo_entries = list(trk.repo_refs)
+        task.data = list(inputs)
+        task.repo_entries = list(repo_refs)
         task.status = "ready"
         from .scheduling import resolve_data_inputs
         resolve_data_inputs(task)   # snapshot collection reads at creation
         return task
 
+    @property
+    def native_enabled(self) -> bool:
+        return self._native is not None
+
     def __len__(self) -> int:
-        return len(self._table)
+        n = len(self._table)
+        if self._native is not None:
+            n += len(self._native)
+        return n

@@ -128,6 +128,13 @@ class TaskClass:
         self.complete_execution = complete_execution
         self.repo = None                  # DataRepo, attached by the taskpool
         self.dependencies_goal = 0        # unused for guarded classes
+        # precomputed (flow_index, dep_index) -> bit position (hot path)
+        self._dep_bits: dict[tuple[int, int], int] = {}
+        bit = 0
+        for fi, f in enumerate(self.flows):
+            for di in range(len(f.deps_in)):
+                self._dep_bits[(fi, di)] = bit
+                bit += 1
 
     # -- keys ---------------------------------------------------------------
     def make_key(self, locals_: dict) -> tuple:
@@ -148,13 +155,10 @@ class TaskClass:
         return mask
 
     def dep_bit(self, flow_index: int, dep_index: int) -> int:
-        bit = 0
-        for fi, f in enumerate(self.flows):
-            for di, _ in enumerate(f.deps_in):
-                if fi == flow_index and di == dep_index:
-                    return bit
-                bit += 1
-        raise IndexError((flow_index, dep_index))
+        try:
+            return self._dep_bits[(flow_index, dep_index)]
+        except KeyError:
+            raise IndexError((flow_index, dep_index))
 
     def iterate_successors(self, task: "Task", visitor: Callable) -> None:
         """Visit every *active* out-dep edge of ``task``.

@@ -217,18 +217,40 @@ class RNDModule(SchedulerModule):
 # ---------------------------------------------------------------------------
 
 class LLModule(SchedulerModule):
+    """Per-stream lock-free LIFOs with stealing.  When the native tier is
+    up, the queue IS the C++ ABA-counted LIFO (the reference's ll is exactly
+    its ``class/lifo.h``); tasks ride as uid handles through a side map.
+    ``llp`` needs priority scans, so it stays on the Python deque."""
+
     name = "ll"
     use_priority = False
 
     def install(self, context: Any) -> None:
-        pass
+        self._tasks: dict[int, Any] = {}
+        self._native = None
+        if not self.use_priority:
+            try:
+                from .. import native        # registers runtime_native
+                if _params.get("runtime_native") and native.available():
+                    self._native = native
+            except Exception:
+                self._native = None
 
     def flow_init(self, es: Any) -> None:
-        es.sched_private = (deque(), threading.Lock())
+        if self._native is not None:
+            es.sched_private = self._native.NativeLifo()
+        else:
+            es.sched_private = (deque(), threading.Lock())
 
     def schedule(self, es: Any, tasks: Sequence[Any], distance: int = 0) -> None:
         target = es if es.sched_private is not None else \
             es.virtual_process.execution_streams[0]
+        if self._native is not None:
+            lifo = target.sched_private
+            for t in tasks:
+                self._tasks[t.uid] = t
+                lifo.push(t.uid)
+            return
         dq, lock = target.sched_private
         with lock:
             dq.extend(tasks)
@@ -239,6 +261,11 @@ class LLModule(SchedulerModule):
         for dist, s in enumerate(order):
             if s.sched_private is None:
                 continue
+            if self._native is not None:
+                uid = s.sched_private.pop()
+                if uid is None:
+                    continue
+                return self._tasks.pop(uid), min(dist, 1)
             dq, lock = s.sched_private
             with lock:
                 if not dq:
@@ -256,12 +283,17 @@ class LLModule(SchedulerModule):
         for vp in context.virtual_processes:
             for es in vp.execution_streams:
                 es.sched_private = None
+        self._tasks = {}
 
     def pending_tasks(self, context: Any) -> int:
         n = 0
         for vp in context.virtual_processes:
             for es in vp.execution_streams:
-                if es.sched_private is not None:
+                if es.sched_private is None:
+                    continue
+                if self._native is not None:
+                    n += len(es.sched_private)
+                else:
                     n += len(es.sched_private[0])
         return n
 
