@@ -22,10 +22,11 @@ Backends:
   reference's oversubscribed-MPI test runs (SURVEY §4): the *protocol* layer
   (remote_dep) is exercised unchanged; only the byte transport is local.
   ``get`` copies the source buffer (the stand-in for an ICI DMA read).
-- A multi-host ICI/DCN backend implements the same vtable with activation
-  AMs over DCN and payload movement as device-to-device transfers
-  (jax ``device_put`` across hosts / XLA collectives for the regular
-  patterns); see §5.8 of SURVEY.md for the mapping.
+- :class:`~parsec_tpu.comm.device_fabric.DeviceCommEngine` over
+  :class:`~parsec_tpu.comm.device_fabric.DeviceFabric` — the device-backed
+  transport: each rank owns one JAX device, ``mem_register`` pins payloads
+  device-resident, ``get`` is a device-to-device ``jax.device_put`` (ICI DMA
+  on hardware), AMs stay host-side; see §5.8 of SURVEY.md for the mapping.
 """
 
 from __future__ import annotations
@@ -44,6 +45,7 @@ AM_TAG_GET_ACK = 3       # remote-completion notification after a get
 AM_TAG_ACTIVATE = 4      # remote-dep activation
 AM_TAG_TERMDET = 5       # termination-detection waves (fourcounter)
 AM_TAG_BARRIER = 6       # context-level sync barrier
+AM_TAG_DTD = 7           # DTD cross-rank data pushes / flushes
 AM_TAG_USER_BASE = 16    # first tag available to applications/DSLs
 
 
@@ -136,10 +138,18 @@ class CommEngine:
 
     # -- registered memory / one-sided ---------------------------------------
     def mem_register(self, value: Any, refcount: int = 1,
-                     on_drained: Callable[[], None] | None = None) -> MemHandle:
-        """Publish a buffer for one-sided GETs.  The caller hands ownership
-        of ``value`` to the engine: it must be a private snapshot (the last
-        consumer may receive the buffer itself, not a copy)."""
+                     on_drained: Callable[[], None] | None = None,
+                     owned: bool = False) -> MemHandle:
+        """Publish a buffer for one-sided GETs.
+
+        The engine needs a stable snapshot (the last consumer may receive the
+        registered buffer itself, not a copy), so mutable host arrays are
+        copied here unless the caller asserts ownership with ``owned=True``
+        — the invariant lives at the API boundary, not in caller convention.
+        Immutable payloads (JAX arrays) alias safely either way.
+        """
+        if not owned and isinstance(value, np.ndarray):
+            value = value.copy()
         h = MemHandle(self.rank, value, refcount, on_drained)
         with self._mem_lock:
             self._mem[h.handle_id] = h

@@ -32,13 +32,24 @@ from .remote_dep import RemoteDepEngine
 
 
 def run_multirank(nranks: int, fn: Callable[[Context, int, int], Any],
-                  nb_cores: int = 0, timeout: float = 120.0) -> list[Any]:
+                  nb_cores: int = 0, timeout: float = 120.0,
+                  transport: str = "inproc",
+                  devices: list | None = None) -> list[Any]:
     """Run ``fn(ctx, rank, nranks)`` on every rank; returns per-rank results.
 
     ``nb_cores=0`` ranks drive progress from ``wait()`` (the master-thread
     funneled mode) — the default for tests, deterministic and cheap.
+
+    ``transport="device"`` attaches the device-backed engine
+    (:mod:`parsec_tpu.comm.device_fabric`): rank *i* owns JAX device *i* and
+    payloads move device-to-device — the configuration the driver's
+    multichip dryrun certifies.
     """
-    fabric = InprocFabric(nranks)
+    if transport == "device":
+        from .device_fabric import DeviceFabric
+        fabric: InprocFabric = DeviceFabric(nranks, devices)
+    else:
+        fabric = InprocFabric(nranks)
     results: list[Any] = [None] * nranks
     errors: list[BaseException | None] = [None] * nranks
 

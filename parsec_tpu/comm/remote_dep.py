@@ -45,14 +45,24 @@ from ..data.data import data_create
 from ..runtime.scheduling import (ExecutionStream, _find_input_dep,
                                   apply_writeback_to_home, schedule_tasks)
 from ..runtime.task import Task
-from .engine import (AM_TAG_ACTIVATE, AM_TAG_GET_ACK, AM_TAG_TERMDET,
-                     CommEngine)
+from .engine import (AM_TAG_ACTIVATE, AM_TAG_DTD, AM_TAG_GET_ACK,
+                     AM_TAG_TERMDET, CommEngine)
 
 _params.register("comm_short_limit", 4096,
                  "payloads at most this many bytes ride inside the "
                  "activation message (short-message inlining)")
 _params.register("comm_bcast_tree", "binomial",
                  "multi-peer activation propagation: binomial|chain|star")
+
+
+def _wire_value(value: Any) -> Any:
+    """Normalize a payload for the wire: JAX arrays stay device-resident
+    (immutable — the device transport moves them D2D); everything else
+    becomes a host ndarray."""
+    from .device_fabric import is_device_array
+    if is_device_array(value):
+        return value
+    return np.asarray(value)
 
 
 # ---------------------------------------------------------------------------
@@ -133,10 +143,11 @@ class RemoteDepEngine:
         # activation seq -> (taskpool, parent_rank or None)
         self._inflight: dict[int, Any] = {}
         self._iflock = threading.Lock()
-        # activations whose taskpool comm-id is not registered yet
-        # (cf. DEP_NEW_TASKPOOL delays, remote_dep_mpi.c); guarded by a lock:
-        # appended from worker progress, replayed from the enqueuing thread
-        self._pending_unknown_tp: list[tuple[int, dict]] = []
+        # activations/DTD messages whose taskpool comm-id is not registered
+        # yet (cf. DEP_NEW_TASKPOOL delays, remote_dep_mpi.c); guarded by a
+        # lock: appended from worker progress, replayed from the enqueuing
+        # thread; entries are (handler, src, msg)
+        self._pending_unknown_tp: list[tuple[Any, int, dict]] = []
         self._pending_lock = threading.Lock()
         # distributed termdet monitors by taskpool comm-id, + stashed tokens
         self._termdet: dict[int, Any] = {}
@@ -144,6 +155,7 @@ class RemoteDepEngine:
         ce.tag_register(AM_TAG_ACTIVATE, self._on_activate)
         ce.tag_register(AM_TAG_GET_ACK, self._on_ack)
         ce.tag_register(AM_TAG_TERMDET, self._on_termdet)
+        ce.tag_register(AM_TAG_DTD, self._on_dtd)
 
     # ------------------------------------------------------------ lifecycle
     def enable(self) -> None:
@@ -211,20 +223,24 @@ class RemoteDepEngine:
                 desc = {"flow_index": fi,
                         "writeback": bool(out.writeback_ranks)}
                 if out.copy is not None:
-                    value = np.asarray(out.copy.value)
+                    value = _wire_value(out.copy.value)
                     desc["version"] = out.copy.version
                     if value.nbytes <= _params.get("comm_short_limit"):
                         # receiver must own its bytes even in-process
-                        desc["inline"] = value.copy()
+                        # (immutable device arrays ride as-is)
+                        desc["inline"] = (value.copy()
+                                          if isinstance(value, np.ndarray)
+                                          else value)
                     else:
                         nchildren = len(tree_children(
                             _params.get("comm_bcast_tree"), 0,
                             len(ranks) + 1))
                         # snapshot at registration: a local successor may
-                        # mutate the live tile in place before the remote GET
-                        # is served (the reference retains a refcounted data
-                        # copy for the whole send)
-                        h = self.ce.mem_register(value.copy(),
+                        # mutate the live host tile in place before the
+                        # remote GET is served (the reference retains a
+                        # refcounted data copy for the whole send); the
+                        # engine copies mutable buffers at the boundary
+                        h = self.ce.mem_register(value,
                                                  refcount=nchildren)
                         desc["wire"] = h.wire()
                         desc["shape"] = value.shape
@@ -296,10 +312,10 @@ class RemoteDepEngine:
             self._pending_termdet = [
                 t for t in self._pending_termdet if t["tp"] != tp.comm_id]
             replay = [m for m in self._pending_unknown_tp
-                      if m[1]["tp"] == tp.comm_id]
+                      if m[2]["tp"] == tp.comm_id]
             self._pending_unknown_tp = [
                 m for m in self._pending_unknown_tp
-                if m[1]["tp"] != tp.comm_id]
+                if m[2]["tp"] != tp.comm_id]
         if replay_td and not distributed:
             raise RuntimeError(
                 f"rank {self.my_rank}: received termdet wave tokens for "
@@ -307,18 +323,43 @@ class RemoteDepEngine:
                 f"distributed — termdet selection differs across ranks")
         for token in replay_td:
             tp.tdm.on_token(token)
-        for src, msg in replay:
-            self._on_activate(self.ce, src, msg)
+        for handler, src, msg in replay:
+            handler(self.ce, src, msg)
 
-    def _on_activate(self, eng, src: int, msg: dict) -> None:
+    def _lookup_or_pend(self, handler, src: int, msg: dict):
         tp = self.ctx._tp_by_comm_id.get(msg["tp"])
         if tp is None:
             with self._pending_lock:
                 # re-check under the lock: registration may have just landed
                 tp = self.ctx._tp_by_comm_id.get(msg["tp"])
                 if tp is None:
-                    self._pending_unknown_tp.append((src, msg))
-                    return
+                    self._pending_unknown_tp.append((handler, src, msg))
+        return tp
+
+    # ------------------------------------------------ DTD cross-rank channel
+    def dtd_send(self, tp: Any, dst: int, msg: dict) -> None:
+        """Ship a DTD protocol message (tile push / flush) to ``dst``,
+        holding a termdet pending action until the ack lands (the
+        DEP_DTD_DELAYED_RELEASE-era accounting, ``remote_dep_mpi.c:2022``)."""
+        seq = next(self._seq)
+        with self._iflock:
+            self._inflight[seq] = tp
+        tp.tdm.taskpool_addto_nb_pa(+1)
+        tp.tdm.on_comm_sent()
+        self.ce.send_am(AM_TAG_DTD, dst, dict(msg, tp=tp.comm_id, seq=seq))
+
+    def _on_dtd(self, eng, src: int, msg: dict) -> None:
+        tp = self._lookup_or_pend(self._on_dtd, src, msg)
+        if tp is None:
+            return
+        tp.tdm.on_comm_recv()
+        tp._on_dtd_message(self, src, msg)
+        self.ce.send_am(AM_TAG_GET_ACK, src, {"seq": msg["seq"]})
+
+    def _on_activate(self, eng, src: int, msg: dict) -> None:
+        tp = self._lookup_or_pend(self._on_activate, src, msg)
+        if tp is None:
+            return
         want = [d for d in msg["outputs"] if "wire" in d]
         # every receiver owns its bytes: an inline payload forwarded down the
         # tree would otherwise alias across ranks
@@ -403,9 +444,11 @@ class RemoteDepEngine:
             fwd["outputs"] = [dict(d) for d in msg["outputs"]]
             for d in fwd["outputs"]:
                 if "wire" in d:
-                    # snapshot: the landed buffer is simultaneously handed to
-                    # local successors, which may mutate it in place
-                    value = np.asarray(landed[d["flow_index"]]).copy()
+                    # snapshot: the landed host buffer is simultaneously
+                    # handed to local successors, which may mutate it in
+                    # place (the engine copies mutable buffers; device
+                    # arrays are immutable and alias)
+                    value = _wire_value(landed[d["flow_index"]])
                     h = self.ce.mem_register(value, refcount=len(children))
                     d["wire"] = h.wire()
             self._send_to_children(tp, fwd, my_pos=my_pos)

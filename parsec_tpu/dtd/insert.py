@@ -87,10 +87,16 @@ class DTDTile:
     ``SET_LAST_ACCESSOR`` discipline: a new reader depends on the last writer
     and joins ``last_users``; a new writer depends on the last writer (WAW)
     *and* every reader since (WAR), then resets the chain.
+
+    Across ranks the chain contains **shell tasks** for remotely-routed
+    insertions (the reference's remote-shell discipline,
+    ``insert_function.c:821,866``): shells are inert position markers whose
+    data effects are realized by snapshot *pushes* — see
+    :meth:`DTDTaskpool._link_tile`.
     """
 
     __slots__ = ("data", "dc", "key", "last_writer", "last_users", "_lock",
-                 "flushed")
+                 "flushed", "wire_key", "_pristine_sent")
 
     def __init__(self, data: Any, dc: Any = None, key: tuple = ()) -> None:
         self.data = data              # the master Data record
@@ -100,6 +106,11 @@ class DTDTile:
         self.last_users: list[tuple[DTDTask, int]] = []
         self._lock = threading.Lock()
         self.flushed = False
+        # rank-stable identity for the wire (collections carry names; bare
+        # arrays are process-local and single-rank only)
+        self.wire_key: tuple = ((dc.name,) + key if dc is not None
+                                else ("arr",) + key)
+        self._pristine_sent: set[int] = set()   # dedup of pristine pushes
 
     @property
     def rank(self) -> int:
@@ -120,10 +131,18 @@ class _ArgSpec:
 
 
 class DTDTask(Task):
-    """A dynamically-inserted task with per-instance discovered deps."""
+    """A dynamically-inserted task with per-instance discovered deps.
+
+    ``dtd_seq`` is the per-taskpool insertion sequence number — identical on
+    every rank under SPMD insertion, so it names this task on the wire (raw
+    ``uid`` counters are process-global and diverge between in-process rank
+    threads).  ``is_shell`` marks a remotely-routed insertion: an inert
+    marker in the accessor chains, never scheduled locally.
+    """
 
     __slots__ = ("body", "args", "deps_pending", "successors", "completed",
-                 "_dlock", "tiles")
+                 "_dlock", "tiles", "dtd_seq", "is_shell", "rank",
+                 "push_records")
 
     def __init__(self, taskpool: Any, task_class: TaskClass, body: Callable,
                  args: list[_ArgSpec], priority: int = 0) -> None:
@@ -138,6 +157,11 @@ class DTDTask(Task):
         self.completed = False
         self._dlock = threading.Lock()
         self.tiles: list[DTDTile | None] = [None] * len(task_class.flows)
+        self.dtd_seq = -1
+        self.is_shell = False
+        self.rank = 0
+        # (flow_index, dst_rank): snapshot-push the written tile on completion
+        self.push_records: set[tuple[int, int]] = set()
 
     def unpack_args(self) -> list[Any]:
         """``parsec_dtd_unpack_args``: resolved argument values in insert
@@ -216,6 +240,36 @@ def _dtd_flush_body(arr, tile: "DTDTile") -> None:
     tile.flushed = True
 
 
+def _snapshot(value: Any) -> Any:
+    """A stable payload for the wire: host arrays are copied (later local
+    writers may mutate them in place), device arrays are immutable."""
+    from ..comm.device_fabric import is_device_array
+    if is_device_array(value):
+        return value
+    return np.asarray(value).copy()
+
+
+class _Arrival:
+    """One expected cross-rank tile payload, keyed by (tile wire key,
+    producing task's insertion seq; -1 = the pristine pre-writer value).
+
+    Local consumer tasks register as waiters; the landing push installs the
+    payload as a fresh host copy on the tile's data record (so later chain
+    accessors and the flush see it) and releases the waiters.  Landing and
+    waiting may happen in either order (a push can outrun the consumer's
+    insertion, and a tile may not even exist locally yet when its payload
+    lands)."""
+
+    __slots__ = ("value", "version", "copy", "landed", "waiters")
+
+    def __init__(self) -> None:
+        self.value = None
+        self.version = 0
+        self.copy = None          # installed DataCopy (made once, lazily)
+        self.landed = False
+        self.waiters: list[tuple[DTDTask, int]] = []
+
+
 class DTDTaskpool(Taskpool):
     """``parsec_dtd_taskpool_new``: a taskpool whose DAG is discovered from
     the insertion order of tasks touching shared tiles."""
@@ -231,6 +285,12 @@ class DTDTaskpool(Taskpool):
         self._closed = False
         self.window_size = _params.get("dtd_window_size")
         self.threshold_size = _params.get("dtd_threshold_size")
+        # -- cross-rank state (shells + push/arrival protocol) --------------
+        self._insert_seq = 0
+        self._arrivals: dict[tuple, _Arrival] = {}
+        self._alock = threading.Lock()
+        self._tiles_by_wire: dict[tuple, DTDTile] = {}
+        self._pending_flush: dict[tuple, tuple] = {}   # wire -> (value, ver)
 
     # ------------------------------------------------------------- lifecycle
     def startup(self, context: Any) -> list[Task]:
@@ -269,7 +329,13 @@ class DTDTaskpool(Taskpool):
             if t is None:
                 t = DTDTile(dc.data_of(*key), dc=dc, key=key)
                 self._tiles[k] = t
-            return t
+                self._tiles_by_wire[t.wire_key] = t
+                flush = self._pending_flush.pop(t.wire_key, None)
+            else:
+                flush = None
+        if flush is not None:
+            self._apply_flush(t, *flush)
+        return t
 
     def tile_of_array(self, array: Any, key: Any = None) -> DTDTile:
         """Tile over a bare array (tests/small apps; no collection)."""
@@ -321,24 +387,28 @@ class DTDTaskpool(Taskpool):
     # --------------------------------------------------------------- insert
     def insert_task(self, body: Callable, *args: Any,
                     name: str | None = None, priority: int = 0,
-                    tpu_kernel: str | None = None) -> DTDTask:
+                    tpu_kernel: str | None = None,
+                    _rank: int | None = None) -> DTDTask:
         """``parsec_dtd_insert_task``.  Each argument is either a bare value
         (treated as VALUE) or a tuple ``(obj, flags)``; data arguments are
         :class:`DTDTile` (or arrays, auto-wrapped via :meth:`tile_of_array`).
+
+        Across ranks every rank runs the same insertion program (SPMD, the
+        reference discipline): the AFFINITY argument's tile decides the
+        executing rank (``insert_function.h:61``; default rank 0), tasks
+        routed elsewhere become inert *shells* in the accessor chains, and
+        cross-rank dataflow is realized by snapshot pushes keyed by the
+        producer's insertion sequence number (see :meth:`_link_tile`).
         """
         if self.context is None:
             raise RuntimeError("taskpool not enqueued in a context")
+        multirank = self.context.nb_ranks > 1
         specs: list[_ArgSpec] = []
         for a in args:
             if isinstance(a, tuple) and len(a) == 2 and isinstance(a[1], int):
                 obj, flags = a
             else:
                 obj, flags = a, VALUE
-            if flags & AFFINITY and self.context.nb_ranks > 1:
-                # rank routing of DTD tasks needs the remote-shell protocol;
-                # fail loudly rather than silently running on the wrong rank
-                raise NotImplementedError(
-                    "DTD AFFINITY across ranks is not wired up yet")
             if not (flags & (VALUE | SCRATCH | REF)):
                 if isinstance(obj, np.ndarray):
                     obj = self.tile_of_array(obj)
@@ -346,12 +416,23 @@ class DTDTaskpool(Taskpool):
                     raise TypeError(
                         f"data argument must be a DTDTile or ndarray, "
                         f"got {type(obj).__name__}")
+                if multirank and obj.dc is None:
+                    raise ValueError(
+                        "cross-rank DTD needs collection-backed tiles "
+                        "(bare arrays have no rank-stable identity)")
             specs.append(_ArgSpec(obj, flags))
         tc = self._class_for(body, specs, name, tpu_kernel)
         task = DTDTask(self, tc, body, specs, priority=priority)
-        self.tdm.taskpool_addto_nb_tasks(+1)
-        with self._icond:
-            self._inflight += 1
+        task.dtd_seq = self._insert_seq = self._insert_seq + 1
+        if multirank:
+            task.rank = _rank if _rank is not None else next(
+                (s.obj.rank for s in specs
+                 if s.flags & AFFINITY and isinstance(s.obj, DTDTile)), 0)
+            task.is_shell = task.rank != self.context.my_rank
+        if not task.is_shell:
+            self.tdm.taskpool_addto_nb_tasks(+1)
+            with self._icond:
+                self._inflight += 1
 
         # thread dependencies through each tracked data argument
         fi = 0
@@ -365,10 +446,13 @@ class DTDTaskpool(Taskpool):
             tile: DTDTile = spec.obj
             task.tiles[spec.flow_index] = tile
             if spec.flags & DONT_TRACK:
-                self._attach_tile_copy(task, spec, tile)
+                if not task.is_shell:
+                    self._attach_tile_copy(task, spec, tile)
                 continue
             self._link_tile(task, spec, tile)
 
+        if task.is_shell:
+            return task
         ready = False
         with task._dlock:
             task.deps_pending -= 1  # drop the insertion guard
@@ -388,23 +472,76 @@ class DTDTaskpool(Taskpool):
 
     def _link_tile(self, task: DTDTask, spec: _ArgSpec, tile: DTDTile) -> None:
         """The SET_LAST_ACCESSOR walk: register RAW/WAR/WAW edges from the
-        tile's previous accessors to ``task``."""
+        tile's previous accessors to ``task``.
+
+        Cross-rank edges (chain positions held by shells) become **snapshot
+        pushes** instead of local deps:
+
+        - *local consumer, shell writer*: wait for the writer rank's push,
+          keyed by the writer's insertion seq (an :class:`_Arrival`);
+        - *local consumer, no writer, remote home*: wait for the owner's
+          pristine push (key ``-1``);
+        - *shell consumer, local writer*: record a push on the writer — its
+          completion snapshots the flow value and ships it (WAR-safe: the
+          snapshot is taken before any successor writer is released);
+        - *shell consumer, no writer, local home*: push the pristine value
+          now (insert-time snapshot — any earlier writer would be in the
+          chain, so the home copy is stable; dedup per destination rank).
+
+        Shells in ``last_users`` are skipped by later local writers (no WAR
+        edge needed — their data was snapshotted), matching the reference's
+        remote-shell handling (``insert_function.c:821,866``).
+        """
+        me = self.context.my_rank
+        needs_data = bool(spec.mode & ACCESS_READ)
         deps: list[DTDTask] = []
+        arrival_key: tuple | None = None
+        push_on: DTDTask | None = None
+        pristine_to: int | None = None
         with tile._lock:
             lw = tile.last_writer
+            if not task.is_shell:
+                if needs_data:
+                    if lw is not None and lw[0].is_shell:
+                        arrival_key = (tile.wire_key, lw[0].dtd_seq)
+                    elif lw is None and tile.dc is not None \
+                            and tile.rank != me:
+                        arrival_key = (tile.wire_key, -1)
+                if lw is not None and not lw[0].is_shell:
+                    deps.append(lw[0])          # RAW / WAW
+            else:
+                if needs_data:
+                    if lw is not None and not lw[0].is_shell:
+                        push_on = lw[0]          # push after writer completes
+                    elif lw is None and tile.rank == me:
+                        pristine_to = task.rank  # push the home value now
             if spec.mode == INPUT:
-                if lw is not None:
-                    deps.append(lw[0])
                 tile.last_users.append((task, spec.flow_index))
             else:  # OUTPUT and INOUT both serialize against the chain
-                if lw is not None:
-                    deps.append(lw[0])          # WAW (and RAW for INOUT)
-                for (u, _) in tile.last_users:   # WAR
-                    if u is not task:
-                        deps.append(u)
+                if not task.is_shell:
+                    for (u, _) in tile.last_users:   # WAR (local users only)
+                        if u is not task and not u.is_shell:
+                            deps.append(u)
                 tile.last_users = []
                 tile.last_writer = (task, spec.flow_index)
-        self._attach_tile_copy(task, spec, tile)
+            if push_on is not None:
+                task_rank = task.rank
+                with push_on._dlock:
+                    if not push_on.completed:
+                        push_on.push_records.add(
+                            (lw[1], task_rank))
+                        push_on = None   # completion will ship it
+        if task.is_shell:
+            if push_on is not None:
+                # writer already completed: snapshot and ship immediately
+                self._send_push(tile, push_on, lw[1], task.rank)
+            if pristine_to is not None and pristine_to != me:
+                self._send_pristine(tile, pristine_to)
+            return
+        if arrival_key is not None:
+            self._add_waiter(arrival_key, task, spec.flow_index)
+        else:
+            self._attach_tile_copy(task, spec, tile)
         for pred in deps:
             self._link_dep(pred, task)
 
@@ -417,10 +554,129 @@ class DTDTaskpool(Taskpool):
                     succ.deps_pending += 1
                 pred.successors.append((succ, -1))
 
+    # --------------------------------------------- cross-rank push protocol
+    def _send_push(self, tile: DTDTile, writer: DTDTask, flow_index: int,
+                   dst: int) -> None:
+        """Ship the writer's output for ``tile`` to ``dst`` (keyed by the
+        writer's insertion seq — identical on every rank)."""
+        copy = writer.data[flow_index]
+        self.context.comm_engine.dtd_send(self, dst, {
+            "kind": "push", "tile": tile.wire_key, "writer": writer.dtd_seq,
+            "value": _snapshot(copy.value), "version": copy.version})
+
+    def _send_pristine(self, tile: DTDTile, dst: int) -> None:
+        """Push the pre-writer home value of a tile this rank owns."""
+        if dst in tile._pristine_sent:
+            return
+        tile._pristine_sent.add(dst)
+        home = tile.data.newest_copy()
+        self.context.comm_engine.dtd_send(self, dst, {
+            "kind": "push", "tile": tile.wire_key, "writer": -1,
+            "value": _snapshot(home.value), "version": home.version})
+
+    def _install_arrival_locked(self, tile: DTDTile, arr: _Arrival) -> DataCopy:
+        """Materialize a landed payload as a *new* host copy on the tile's
+        data record (replacing the stale mirror if the version advanced —
+        earlier local readers keep their old copy object untouched, so a
+        late-landing push cannot leak a future value into them)."""
+        if arr.copy is not None:
+            return arr.copy
+        d = tile.data
+        copy = DataCopy(d, 0, value=arr.value, dtt=d.get_copy(0).dtt
+                        if d.get_copy(0) is not None else None)
+        copy.version = arr.version
+        cur = d.get_copy(0)
+        if cur is None or cur.version < copy.version:
+            d.attach_copy(copy)
+        arr.copy = copy
+        arr.value = None
+        return copy
+
+    def _add_waiter(self, key: tuple, task: DTDTask, flow_index: int) -> None:
+        """Block ``task``'s flow on a cross-rank arrival (or attach it
+        immediately if the push already landed).
+
+        The pending-dep is raised *before* the waiter becomes visible: a
+        push landing between publication and the raise would otherwise
+        decrement first and schedule the half-linked task (the insertion
+        guard alone does not order against the comm thread)."""
+        with task._dlock:
+            task.deps_pending += 1
+        with self._alock:
+            arr = self._arrivals.get(key)
+            if arr is None:
+                arr = self._arrivals[key] = _Arrival()
+            if arr.landed:
+                task.data[flow_index] = self._install_arrival_locked(
+                    task.tiles[flow_index], arr)
+            else:
+                arr.waiters.append((task, flow_index))
+                return
+        # already landed: retract the provisional dep (the insertion guard
+        # is still held, so this cannot reach zero / schedule)
+        with task._dlock:
+            task.deps_pending -= 1
+
+    def _land_arrival(self, key: tuple, value: Any, version: int) -> None:
+        with self._tlock:
+            tile = self._tiles_by_wire.get(key[0])
+        with self._alock:
+            arr = self._arrivals.get(key)
+            if arr is None:
+                arr = self._arrivals[key] = _Arrival()
+            if arr.landed:
+                return   # duplicate delivery
+            arr.value, arr.version, arr.landed = value, version, True
+            if tile is None and arr.waiters:
+                # waiters imply the tile exists locally (linked via tile_of)
+                t0, fi0 = arr.waiters[0]
+                tile = t0.tiles[fi0]
+            copy = (self._install_arrival_locked(tile, arr)
+                    if tile is not None else None)
+            waiters, arr.waiters = arr.waiters, []
+        ready = []
+        for (t, fi) in waiters:
+            t.data[fi] = copy
+            with t._dlock:
+                t.deps_pending -= 1
+                if t.deps_pending == 0:
+                    t.status = "ready"
+                    ready.append(t)
+        if ready:
+            schedule_tasks(self.context._submit_es, ready, 0)
+
+    def _apply_flush(self, tile: DTDTile, value: Any, version: int) -> None:
+        home = tile.data.get_copy(0)
+        home.value = value
+        home.version = max(home.version, version)
+        tile.flushed = True
+
+    def _on_dtd_message(self, rde: Any, src: int, msg: dict) -> None:
+        """Receive a cross-rank DTD message (dispatched by
+        :meth:`~parsec_tpu.comm.remote_dep.RemoteDepEngine._on_dtd`)."""
+        wire = tuple(msg["tile"])
+        if msg["kind"] == "push":
+            self._land_arrival((wire, msg["writer"]), msg["value"],
+                               msg["version"])
+            return
+        if msg["kind"] == "flush":
+            with self._tlock:
+                tile = self._tiles_by_wire.get(wire)
+                if tile is None:
+                    # tile not materialized here yet: apply at tile_of time
+                    self._pending_flush[wire] = (msg["value"], msg["version"])
+                    return
+            self._apply_flush(tile, msg["value"], msg["version"])
+            return
+        raise ValueError(f"unknown DTD message kind {msg['kind']!r}")
+
     # ------------------------------------------------------------ completion
     def release_task(self, es: Any, task: DTDTask) -> None:
         """``complete_hook_of_dtd`` → ``dtd_release_dep_fct``: bump written
-        tile versions, release instance successors, notify the window."""
+        tile versions, ship cross-rank pushes, release instance successors,
+        notify the window.  Pushes snapshot *before* successors are released
+        — a successor writer mutating the host tile in place cannot corrupt
+        an in-flight payload (the WAR discipline of the shell protocol)."""
         pins.fire(PinsEvent.RELEASE_DEPS_BEGIN, es, task)
         for spec in task.args:
             if spec.flow_index < 0 or spec.flags & SCRATCH:
@@ -433,6 +689,10 @@ class DTDTaskpool(Taskpool):
             task.completed = True
             succs = list(task.successors)
             task.successors.clear()
+            pushes = sorted(task.push_records)
+            task.push_records.clear()
+        for (fi, dst) in pushes:
+            self._send_push(task.tiles[fi], task, fi, dst)
         ready = []
         for (succ, _) in succs:
             with succ._dlock:
@@ -472,9 +732,31 @@ class DTDTaskpool(Taskpool):
         accessor that writes the final version back to the tile's home.
 
         One shared task class serves every flush (the tile rides as an
-        untracked REF arg) — flushes must not consume class slots."""
-        self.insert_task(_dtd_flush_body, (tile, INPUT), (tile, REF),
-                         name="dtd_flush")
+        untracked REF arg) — flushes must not consume class slots.
+
+        Across ranks the flush runs on the rank of the tile's last writer
+        (data-local) and ships the final version to the home rank when they
+        differ (``parsec_dtd_data_flush.c``'s push-to-owner)."""
+        if self.context is None or self.context.nb_ranks <= 1 \
+                or tile.dc is None:
+            self.insert_task(_dtd_flush_body, (tile, INPUT), (tile, REF),
+                             name="dtd_flush")
+            return
+        with tile._lock:
+            lw = tile.last_writer
+        flush_rank = lw[0].rank if lw is not None else tile.rank
+        self.insert_task(self._flush_remote_body, (tile, INPUT), (tile, REF),
+                         name="dtd_flush", _rank=flush_rank)
+
+    def _flush_remote_body(self, arr: Any, tile: DTDTile) -> None:
+        if tile.rank == self.context.my_rank:
+            _dtd_flush_body(arr, tile)
+            return
+        newest = tile.data.newest_copy()
+        self.context.comm_engine.dtd_send(self, tile.rank, {
+            "kind": "flush", "tile": tile.wire_key,
+            "value": _snapshot(newest.value), "version": newest.version})
+        tile.flushed = True
 
     def data_flush_all(self) -> None:
         """``parsec_dtd_data_flush_all`` over every tile seen so far."""

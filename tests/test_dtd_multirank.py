@@ -1,0 +1,108 @@
+"""DTD across ranks: shells, AFFINITY routing, pushes, flushes.
+
+The analog of the reference's MPI-variant DTD tests
+(``tests/dsl/dtd/Testings.cmake`` running each test at -np 2/4/8; remote
+shells ``insert_function.c:821,866``; flush-to-owner
+``parsec_dtd_data_flush.c``).
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm import run_multirank
+from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
+from parsec_tpu.dtd.insert import (AFFINITY, INOUT, INPUT, DTDTaskpool)
+from parsec_tpu.dtd.multirank_check import dtd_gemm_multirank_check
+
+
+def _inc(x):
+    return np.asarray(x) + 1.0
+
+
+def _chain_body(ctx, rank, nranks):
+    """A value hops rank-to-rank: task i runs on rank i%n (AFFINITY on a
+    per-rank anchor tile), INOUT on the shared tile X — every hop is a
+    cross-rank RAW push."""
+    nt = 6
+    X = VectorTwoDimCyclic("X", lm=1, mb=1, P=nranks, myrank=rank,
+                           init_fn=lambda m, size: np.zeros(size))
+    anchors = VectorTwoDimCyclic("W", lm=nranks, mb=1, P=nranks, myrank=rank,
+                                 init_fn=lambda m, size: np.zeros(size))
+    tp = DTDTaskpool("chain")
+    ctx.add_taskpool(tp)
+    tX = tp.tile_of(X, 0)
+
+    def hop(anchor, x):
+        return np.asarray(x) + 1.0
+
+    for i in range(nt):
+        tA = tp.tile_of(anchors, i % nranks)
+        tp.insert_task(hop, (tA, INPUT | AFFINITY), (tX, INOUT), name="hop")
+    tp.data_flush_all()
+    tp.wait(timeout=60)
+    ctx.comm_barrier()
+    if rank == 0:   # X's home rank
+        return float(np.asarray(X.data_of(0).newest_copy().value)[0])
+    return None
+
+
+@pytest.mark.parametrize("nranks", [2, 4])
+def test_dtd_chain_across_ranks(nranks):
+    res = run_multirank(nranks, _chain_body)
+    assert res[0] == 6.0
+
+
+@pytest.mark.parametrize("nranks", [2, 4, 8])
+def test_dtd_gemm_multirank(nranks):
+    dtd_gemm_multirank_check(nranks)
+
+
+def test_dtd_gemm_multirank_device_transport():
+    dtd_gemm_multirank_check(4, transport="device")
+
+
+def test_dtd_single_rank_still_clean():
+    """nb_ranks=1 must not touch shells/pushes (regression guard)."""
+    res = run_multirank(1, _chain_body)
+    assert res[0] == 6.0
+
+
+def _war_body(ctx, rank, nranks):
+    """WAR across ranks: rank 0 writes X, a remote rank reads it, rank 0
+    overwrites it — the remote reader must see the FIRST version (snapshot
+    pushes, not live aliases)."""
+    X = VectorTwoDimCyclic("X", lm=1, mb=1, P=nranks, myrank=rank,
+                           init_fn=lambda m, size: np.zeros(size))
+    R = VectorTwoDimCyclic("R", lm=nranks, mb=1, P=nranks, myrank=rank,
+                           init_fn=lambda m, size: np.zeros(size))
+    tp = DTDTaskpool("war")
+    ctx.add_taskpool(tp)
+    tX = tp.tile_of(X, 0)
+    tR = tp.tile_of(R, 1 % nranks)
+
+    def write7(x):
+        return np.full_like(np.asarray(x), 7.0)
+
+    def capture(r, x):
+        return np.asarray(x).copy()
+
+    def write9(x):
+        return np.full_like(np.asarray(x), 9.0)
+
+    tp.insert_task(write7, (tX, INOUT | AFFINITY), name="w7")       # rank 0
+    tp.insert_task(capture, (tR, INOUT | AFFINITY), (tX, INPUT),
+                   name="cap")                                      # rank 1
+    tp.insert_task(write9, (tX, INOUT | AFFINITY), name="w9")       # rank 0
+    tp.data_flush_all()
+    tp.wait(timeout=60)
+    ctx.comm_barrier()
+    if rank == 1 % nranks:
+        return float(np.asarray(R.data_of(1 % nranks)
+                                .newest_copy().value)[0])
+    return None
+
+
+@pytest.mark.parametrize("nranks", [2, 4])
+def test_dtd_war_across_ranks(nranks):
+    res = run_multirank(nranks, _war_body)
+    assert res[1 % nranks] == 7.0
