@@ -422,16 +422,17 @@ class RemoteDepEngine:
                         apply_writeback_to_home(dc, key, copy)
                 return
             succ_tc = tp.task_class(dep.target_class)
-            succ_locals = dep.target_params(t.locals)
-            rank = self._succ_rank(succ_tc, succ_locals)
-            if rank != self.my_rank:
-                return
-            fi, di = _find_input_dep(succ_tc, dep.target_flow, tc.name,
-                                     succ_locals)
-            rt = self.ctx.deps.release_dep(tp, succ_tc, succ_locals, fi, di,
-                                           copies.get(flow.flow_index), None)
-            if rt is not None:
-                ready.append(rt)
+            for succ_locals in dep.each_target(t.locals):
+                rank = self._succ_rank(succ_tc, succ_locals)
+                if rank != self.my_rank:
+                    continue
+                fi, di = _find_input_dep(succ_tc, dep.target_flow, tc.name,
+                                         succ_locals)
+                rt = self.ctx.deps.release_dep(tp, succ_tc, succ_locals, fi,
+                                               di, copies.get(flow.flow_index),
+                                               None)
+                if rt is not None:
+                    ready.append(rt)
 
         tc.iterate_successors(ghost, visitor)
 

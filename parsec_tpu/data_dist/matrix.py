@@ -89,6 +89,8 @@ class TiledMatrix(DataCollection):
         out = np.zeros((self.lm, self.ln), dtype=self.dtype)
         for m in range(self.mt):
             for n in range(self.nt):
+                if not self.has_tile(m, n):
+                    continue
                 if self.rank_of(m, n) != self.myrank and self.nodes > 1:
                     continue
                 t = self.data_of(m, n).newest_copy().value

@@ -19,10 +19,11 @@ SURVEY §2.5, §3.5) redesigned around XLA's execution model:
   eviction-by-writeback when an HBM budget is exceeded — the zone-malloc
   reservation becomes a byte budget, since XLA owns physical HBM.
 - **Batched execution** (TPU-first addition): consecutive pending tasks of
-  the same task class with the same kernel may be stacked and dispatched as
-  one vmapped XLA call — tiny-task dispatch overhead amortizes onto the MXU
-  (no reference analog; this is the idiomatic TPU answer to its per-task
-  CUDA-stream pipelining).
+  the same task class with the same kernel are stacked and dispatched as
+  ONE vmapped XLA call (:meth:`TPUDevice._run_vmapped`, consuming the same
+  traceable-kernel registry as the compiled lowering) — tiny-task dispatch
+  overhead amortizes onto the MXU (no reference analog; this is the
+  idiomatic TPU answer to its per-task CUDA-stream pipelining).
 """
 
 from __future__ import annotations
@@ -43,10 +44,29 @@ _params.register("device_tpu_max_inflight", 32,
                  "bound on enqueued-but-unconfirmed device tasks")
 _params.register("device_tpu_batch", True,
                  "stack same-class pending tasks into one vmapped dispatch")
+_params.register("device_tpu_batch_max", 64,
+                 "largest task batch a single vmapped dispatch may service")
 
 
 def _copy_nbytes(copy: DataCopy) -> int:
     return getattr(copy.value, "nbytes", 0) if copy.value is not None else 0
+
+
+_index_cache: dict[int, Any] = {}
+
+
+def _index_batch(col: Any, i: int) -> Any:
+    """``col[i]`` with the index as a traced argument (one compile per
+    stacked shape instead of one per distinct i)."""
+    import jax
+    fn = _index_cache.get(0)
+    if fn is None:
+        import jax.lax
+        fn = _index_cache[0] = jax.jit(
+            lambda a, j: jax.lax.dynamic_index_in_dim(a, j, 0,
+                                                      keepdims=False))
+    import numpy as np
+    return fn(col, np.int32(i))
 
 
 class TPUDeviceTask:
@@ -86,6 +106,9 @@ class TPUDevice(Device):
         # bounded in-flight window (poor-man's event ring)
         self._inflight: deque[Any] = deque()
         self._max_inflight = _params.get("device_tpu_max_inflight")
+        # vmapped-dispatch cache (dyld name -> jitted vmap of the traceable)
+        self._vmap_cache: dict[str, Callable] = {}
+        self.batched_dispatches = 0   # XLA calls that serviced >1 task
 
     # ------------------------------------------------------------- memory
     def _hbm_budget(self) -> int:
@@ -203,7 +226,47 @@ class TPUDevice(Device):
                     self._managing = False
                     return HOOK_RETURN_ASYNC
                 batch = self._take_batch_locked()
+            if _params.get("device_tpu_batch"):
+                self._flood_from_scheduler(batch)
             self._run_batch(batch)
+
+    def _flood_from_scheduler(self, batch: list[TPUDeviceTask]) -> None:
+        """Pull additional ready same-class tasks straight from the
+        scheduler into this dispatch batch.
+
+        The reference's manager accumulates batches passively because many
+        workers enqueue concurrently (``device_gpu.c:2457-2473``); under the
+        TPU module a single driving thread hands tasks over one at a time,
+        so the manager *actively* drains the scheduler of vmappable
+        same-class work (and puts anything else back).  Only classes with a
+        traceable incarnation are worth flooding — everything else would
+        fall back to the per-task path anyway.
+        """
+        from ..ptg.lowering import find_traceable
+        from ..runtime.scheduling import prepare_input
+
+        first = batch[0]
+        es = first.es
+        tc = first.task.task_class
+        dyld = next((c.dyld for c in tc.chores
+                     if c.device_type == self.type and c.dyld), None)
+        if dyld is None or find_traceable(dyld) is None:
+            return
+        maxb = _params.get("device_tpu_batch_max")
+        stash: list[tuple[Any, int]] = []
+        sched = es.context.scheduler
+        while len(batch) < maxb:
+            t, distance = sched.select(es)
+            if t is None:
+                break
+            if t.task_class is tc and registry.best_device(
+                    t, self.type) is self:
+                prepare_input(es, t)
+                batch.append(TPUDeviceTask(es, t, first.submit))
+            else:
+                stash.append((t, distance))
+        for t, distance in stash:
+            sched.schedule(es, [t], distance)
 
     def _take_batch_locked(self) -> list[TPUDeviceTask]:
         batch = [self._pending.popleft()]
@@ -223,24 +286,89 @@ class TPUDevice(Device):
                 dtask.stage_in(self, dtask.task)
             else:
                 self.stage_in(dtask.task)
-        for dtask in batch:   # exec phase (exec streams analog)
-            out = dtask.submit(dtask.es, dtask.task, self)
-            self._note_inflight(out)
-            self.executed_tasks += 1
-            # written flows become dirty device copies (coherency epilog,
-            # cf. kernel_epilog versions->owner, device_gpu.c:2251)
-            from ..data.data import ACCESS_WRITE
-            for f in dtask.task.task_class.flows:
-                if f.is_ctl or not (f.access & ACCESS_WRITE):
-                    continue
-                c = dtask.task.data[f.flow_index]
-                if c is not None and c.device_index == self.device_index:
-                    c.coherency = COHERENCY_OWNED
-                    c.original.owner_device = self.device_index
+        if len(batch) > 1 and self._run_vmapped(batch):
+            pass              # one XLA call serviced the whole batch
+        else:
+            for dtask in batch:   # exec phase (exec streams analog)
+                out = dtask.submit(dtask.es, dtask.task, self)
+                self._note_inflight(out)
+                self.executed_tasks += 1
+                self._mark_written(dtask.task)
         for dtask in batch:   # completion (epilog analog)
             if dtask.stage_out is not None:
                 dtask.stage_out(self, dtask.task)
             complete_execution(dtask.es, dtask.task)
+
+    def _mark_written(self, task: Any) -> None:
+        # written flows become dirty device copies (coherency epilog,
+        # cf. kernel_epilog versions->owner, device_gpu.c:2251)
+        from ..data.data import ACCESS_WRITE
+        for f in task.task_class.flows:
+            if f.is_ctl or not (f.access & ACCESS_WRITE):
+                continue
+            c = task.data[f.flow_index]
+            if c is not None and c.device_index == self.device_index:
+                c.coherency = COHERENCY_OWNED
+                c.original.owner_device = self.device_index
+
+    # ------------------------------------------------- vmapped batch dispatch
+    def _run_vmapped(self, batch: list[TPUDeviceTask]) -> bool:
+        """Dispatch a same-class batch as ONE vmapped XLA call (the TPU-first
+        answer to per-task CUDA-stream pipelining: tiny-task dispatch
+        overhead amortizes onto the MXU).
+
+        Eligibility: the class's device chore has a jax-traceable
+        incarnation registered under its ``dyld`` name
+        (:func:`parsec_tpu.ptg.lowering.register_traceable` — the same
+        contract the compiled lowering consumes), every task's flow tiles
+        agree on shape/dtype, and no task overrides its stage hooks.
+        Returns False to fall back to per-task submission.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..data.data import ACCESS_WRITE
+        from ..ptg.lowering import find_traceable
+
+        tc = batch[0].task.task_class
+        dyld = next((c.dyld for c in tc.chores
+                     if c.device_type == self.type and c.dyld), None)
+        if dyld is None:
+            return False
+        tr = find_traceable(dyld)
+        if tr is None:
+            return False
+        data_flows = [f for f in tc.flows if not f.is_ctl]
+        cols = []
+        for f in data_flows:
+            vals = [t.task.data[f.flow_index].value for t in batch]
+            v0 = vals[0]
+            if any(v.shape != v0.shape or v.dtype != v0.dtype
+                   for v in vals[1:]):
+                return False   # ragged tiles: per-task path
+            cols.append(vals)
+        fn = self._vmap_cache.get(dyld)
+        if fn is None:
+            fn = self._vmap_cache[dyld] = jax.jit(jax.vmap(tr.apply))
+        stacked = [jnp.stack(vs) for vs in cols]
+        out = fn(*stacked)
+        written = [f for f in data_flows if f.access & ACCESS_WRITE]
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        assert len(outs) == len(written), (dyld, len(outs), len(written))
+        for w, col in zip(written, outs):
+            self._note_inflight(col)
+            for i, dtask in enumerate(batch):
+                c = dtask.task.data[w.flow_index]
+                # jitted dynamic index: a python-int col[i] bakes the start
+                # into the program and recompiles per i (~20ms each through
+                # the PJRT relay); the traced index compiles once per shape
+                c.value = _index_batch(col, i)
+                c.version += 1
+        for dtask in batch:
+            self.executed_tasks += 1
+            self._mark_written(dtask.task)
+        self.batched_dispatches += 1
+        return True
 
     def _note_inflight(self, out: Any) -> None:
         """Bound the enqueue depth: block on the oldest dispatch once more

@@ -38,11 +38,11 @@ class GrapherModule:
                 if dep.target_class is None:
                     return
                 succ_tc = t.taskpool.task_class(dep.target_class)
-                succ_locals = dep.target_params(t.locals)
-                dst = self._node_id(succ_tc.name,
-                                    succ_tc.make_key(succ_locals))
-                with self._lock:
-                    self.edges.append((nid, dst, flow.name))
+                for succ_locals in dep.each_target(t.locals):
+                    dst = self._node_id(succ_tc.name,
+                                        succ_tc.make_key(succ_locals))
+                    with self._lock:
+                        self.edges.append((nid, dst, flow.name))
 
             try:
                 tc.iterate_successors(task, visitor)

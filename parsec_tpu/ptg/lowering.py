@@ -294,6 +294,8 @@ def _try_chain_collapse(tp, infos, stores: _Stores):
         for d in _active_in_deps(acc, loc):
             if d.target_class == tc.name and d.target_flow == acc.name:
                 pred = d.target_params(loc)
+                if not isinstance(pred, dict):   # range arrow: not a chain
+                    return None
                 diff = [p for p in params if pred[p] != loc[p]]
                 if len(diff) == 1 and loc[diff[0]] - pred[diff[0]] == 1:
                     chain = diff[0]
@@ -331,6 +333,8 @@ def _try_chain_collapse(tp, infos, stores: _Stores):
             if (d.target_class != tc.name or d.target_flow != acc.name):
                 return None
             pred = d.target_params(loc)
+            if not isinstance(pred, dict):
+                return None
             if any(pred[p] != (loc[p] - (p == chain)) for p in params):
                 return None
         succ = [d for d in ao if d.target_class == tc.name
@@ -340,6 +344,8 @@ def _try_chain_collapse(tp, infos, stores: _Stores):
             if len(succ) != 1 or data_out:
                 return None
             nxt = succ[0].target_params(loc)
+            if not isinstance(nxt, dict):
+                return None
             if any(nxt[p] != (loc[p] + (p == chain)) for p in params):
                 return None
         else:
@@ -461,14 +467,15 @@ def _topo_order(tp, infos) -> list[tuple[str, int]]:
                     if d.target_class is None or not d.active(loc):
                         continue
                     tgt_tc = tp.task_class(d.target_class)
-                    tgt_loc = d.target_params(loc)
-                    tgt = index.get((d.target_class, tgt_tc.make_key(tgt_loc)))
-                    if tgt is None:
-                        raise LoweringError(
-                            f"{cname}{info.tc.make_key(loc)} -> missing "
-                            f"successor {d.target_class}({tgt_loc})")
-                    succs[(cname, i)].append(tgt)
-                    indeg[tgt] += 1
+                    for tgt_loc in d.each_target(loc):
+                        tgt = index.get(
+                            (d.target_class, tgt_tc.make_key(tgt_loc)))
+                        if tgt is None:
+                            raise LoweringError(
+                                f"{cname}{info.tc.make_key(loc)} -> missing "
+                                f"successor {d.target_class}({tgt_loc})")
+                        succs[(cname, i)].append(tgt)
+                        indeg[tgt] += 1
     ready = [v for v, n in indeg.items() if n == 0]
     out = []
     while ready:

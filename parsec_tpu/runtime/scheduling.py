@@ -261,25 +261,25 @@ def release_deps(es: ExecutionStream, task: Task) -> None:
             _writeback(t, flow, dep, out_copy)
             return
         succ_tc = tp.task_class(dep.target_class)
-        succ_locals = dep.target_params(t.locals)
-        rank = _rank_of_task(ctx, succ_tc, succ_locals)
-        if rank is not None and rank != ctx.my_rank:
-            remote = ctx.remote_dep_accumulate(remote, t, flow, dep,
-                                               succ_tc, succ_locals, rank)
-            return
-        fi, di = _find_input_dep(succ_tc, dep.target_flow, tc.name,
-                                 succ_locals)
-        repo_ref = None
-        if out_copy is not None:
-            if entry is None:
-                entry = tc.repo.lookup_and_create(t.key)
-            entry.set_output(flow.flow_index, out_copy)
-            repo_ref = (entry, flow.flow_index)
-            nconsumers += 1
-        ready_task = ctx.deps.release_dep(tp, succ_tc, succ_locals, fi, di,
-                                          out_copy, repo_ref)
-        if ready_task is not None:
-            ready.append(ready_task)
+        for succ_locals in dep.each_target(t.locals):
+            rank = _rank_of_task(ctx, succ_tc, succ_locals)
+            if rank is not None and rank != ctx.my_rank:
+                remote = ctx.remote_dep_accumulate(remote, t, flow, dep,
+                                                   succ_tc, succ_locals, rank)
+                continue
+            fi, di = _find_input_dep(succ_tc, dep.target_flow, tc.name,
+                                     succ_locals)
+            repo_ref = None
+            if out_copy is not None:
+                if entry is None:
+                    entry = tc.repo.lookup_and_create(t.key)
+                entry.set_output(flow.flow_index, out_copy)
+                repo_ref = (entry, flow.flow_index)
+                nconsumers += 1
+            ready_task = ctx.deps.release_dep(tp, succ_tc, succ_locals, fi,
+                                              di, out_copy, repo_ref)
+            if ready_task is not None:
+                ready.append(ready_task)
 
     tc.iterate_successors(task, visitor)
     if entry is not None:

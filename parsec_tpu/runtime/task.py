@@ -68,6 +68,18 @@ class Dep:
     def active(self, locals_: dict) -> bool:
         return self.guard is None or bool(self.guard(locals_))
 
+    def each_target(self, locals_: dict) -> tuple[dict, ...]:
+        """Successor instances of this out-dep for ``locals_``.
+
+        ``target_params`` may return one locals dict or a sequence of them —
+        the JDF *range arrow* form (``-> T TRSM(k+1..NT-1, k)``), one edge
+        fanning out to many instances.  Input deps are always single-target.
+        """
+        t = self.target_params(locals_)
+        if isinstance(t, dict):
+            return (t,)
+        return tuple(t)
+
 
 class Flow:
     """A named dataflow of a task class (cf. ``parsec_flow_t``)."""

