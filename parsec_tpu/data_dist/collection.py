@@ -100,8 +100,6 @@ def enumerate_keys(dc: DataCollection) -> list[tuple]:
     if hasattr(dc, "mt"):
         return [(m,) for m in range(dc.mt)]
     if isinstance(dc, DictCollection):
-        keys = dc.known_keys()
-        if keys:
-            return keys
+        return dc.known_keys()   # [] for an empty collection, not an error
     raise TypeError(f"cannot enumerate keys of {type(dc).__name__} "
                     f"{dc.name!r}")

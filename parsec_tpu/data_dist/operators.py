@@ -142,7 +142,7 @@ def broadcast_taskpool(src: DataCollection, src_key: tuple,
     # 2-D tiled matrices alike); COPY tasks are indexed by position in it
     dst_keys = _all_keys(dst)
     p = ptg.PTGBuilder(name, SRC=src, DST=dst, KEY=src_key, DKEYS=dst_keys)
-    nodes = max(len(dst_keys), 1)
+    nodes = len(dst_keys)
 
     w = p.task("ROOT", z=ptg.span(0, 0))
     w.affinity("SRC", lambda g, l: g.KEY)
@@ -151,6 +151,9 @@ def broadcast_taskpool(src: DataCollection, src_key: tuple,
     for r in range(nodes):
         fw.output(succ=("COPY", "X", lambda g, l, r=r: {"r": r}))
     w.body(lambda es, task, g, l: None)
+
+    if nodes == 0:   # empty destination: ROOT alone (nothing to copy into)
+        return p.build()
 
     t = p.task("COPY", r=ptg.span(0, nodes - 1))
     t.affinity("DST", lambda g, l: g.DKEYS[l.r])

@@ -378,16 +378,26 @@ class TPUDevice(Device):
         self._inflight.append(out)
         while len(self._inflight) > self._max_inflight:
             oldest = self._inflight.popleft()
-            try:
-                import jax
-                jax.block_until_ready(oldest)
-            except Exception:
-                pass
+            self._confirm(oldest)
+
+    def _confirm(self, out: Any) -> None:
+        """Wait for an enqueued dispatch; a device-side failure disables
+        this device so later tasks demote to their remaining incarnations
+        (the ``PARSEC_HOOK_RETURN_DISABLE`` path, ``device_gpu.c:2647-2652``)
+        and is re-raised — a failed kernel must not pass silently."""
+        import jax
+        try:
+            jax.block_until_ready(out)
+        except Exception:
+            from ..core.output import warning
+            self.enabled = False
+            warning(f"device {self.name}: dispatch failed; "
+                    "disabling the device for subsequent tasks")
+            raise
 
     def sync(self) -> None:
-        import jax
         while self._inflight:
-            jax.block_until_ready(self._inflight.popleft())
+            self._confirm(self._inflight.popleft())
 
 
 def _flop_rating(kind: str) -> tuple[float, float]:

@@ -247,6 +247,10 @@ class Context:
             if tp in self._active_taskpools:
                 self._active_taskpools.remove(tp)
             self._cond.notify_all()
+        # reclaim any dep-tracker state the taskpool left behind (nothing in
+        # the normal case; an aborted pool would otherwise leak stashed
+        # inputs for the context lifetime — the k64 space is context-wide)
+        self.deps.purge_taskpool(tp.taskpool_id)
 
     def comm_barrier(self) -> None:
         """Collective fence: progress until the fabric is globally silent.

@@ -149,6 +149,20 @@ class DependencyTracking:
         resolve_data_inputs(task)   # snapshot collection reads at creation
         return task
 
+    def purge_taskpool(self, taskpool_id: int) -> None:
+        """Reclaim tracker/input entries of a finished (or aborted) taskpool.
+
+        Normally completion consumes every entry; a taskpool that dies with
+        unsatisfied deps would otherwise leak its stashed input copies for
+        the context lifetime (the k64 space is context-wide)."""
+        with self._inputs_lock:
+            shift = _TC_BITS + _PARAM_BITS
+            for k in [k for k in self._inputs if (k >> shift) == taskpool_id]:
+                del self._inputs[k]
+        for key, _ in list(self._table.items()):
+            if isinstance(key, tuple) and key and key[0] == taskpool_id:
+                self._table.remove(key)
+
     @property
     def native_enabled(self) -> bool:
         return self._native is not None

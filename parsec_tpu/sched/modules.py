@@ -220,7 +220,13 @@ class LLModule(SchedulerModule):
     """Per-stream lock-free LIFOs with stealing.  When the native tier is
     up, the queue IS the C++ ABA-counted LIFO (the reference's ll is exactly
     its ``class/lifo.h``); tasks ride as uid handles through a side map.
-    ``llp`` needs priority scans, so it stays on the Python deque."""
+    ``llp`` needs priority scans, so it stays on the Python deque.
+
+    Steal order differs between tiers by design: the native LIFO can only
+    pop from the top, so steals are LIFO (exactly the reference's ll, which
+    steals via ``parsec_lifo_pop`` too); the Python tier steals FIFO from
+    the victim's bottom for locality.  Both are valid ll semantics — the
+    scheduler contract orders nothing across streams."""
 
     name = "ll"
     use_priority = False
@@ -265,7 +271,10 @@ class LLModule(SchedulerModule):
                 uid = s.sched_private.pop()
                 if uid is None:
                     continue
-                return self._tasks.pop(uid), min(dist, 1)
+                t = self._tasks.pop(uid, None)
+                if t is None:
+                    continue   # remove() raced us during teardown
+                return t, min(dist, 1)
             dq, lock = s.sched_private
             with lock:
                 if not dq:
