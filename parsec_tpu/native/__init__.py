@@ -85,6 +85,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         "pt_deptable_free": ([vp], None),
         "pt_deptable_release": ([vp, u64, u64, u64], ctypes.c_int),
         "pt_deptable_count": ([vp], ctypes.c_long),
+        "pt_dag_new": ([ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+                        ctypes.POINTER(ctypes.c_int32),
+                        ctypes.POINTER(ctypes.c_int32),
+                        ctypes.POINTER(ctypes.c_int64)], vp),
+        "pt_dag_free": ([vp], None),
+        "pt_dag_fetch": ([vp, ctypes.POINTER(ctypes.c_int32),
+                          ctypes.c_int32], ctypes.c_int32),
+        "pt_dag_complete": ([vp, ctypes.POINTER(ctypes.c_int32),
+                             ctypes.c_int32], i64),
+        "pt_dag_remaining": ([vp], i64),
         "pt_counter_new": ([i64], vp),
         "pt_counter_free": ([vp], None),
         "pt_counter_add": ([vp, i64], i64),
@@ -225,6 +235,49 @@ class NativeDepTable(_Handle):
 
     def __len__(self) -> int:
         return self._lib.pt_deptable_count(self._h)
+
+
+class NativeDag(_Handle):
+    """Compiled-DAG executor: indegree counters + CSR successors native-side.
+
+    ``fetch(buf)`` fills a caller-owned ``(ctypes.c_int32 * cap)`` buffer
+    with ready task ids; ``complete(buf, n)`` releases all successors of the
+    batch and returns the outstanding count.  The two calls are the entire
+    select→release loop — Python touches only the chore bodies in between
+    (the scheduling.c:562-575 hot loop, compiled)."""
+
+    def __init__(self, indeg, succ_off, succ, prio=None) -> None:
+        import numpy as np
+        lib = load()
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        indeg = np.ascontiguousarray(indeg, dtype=np.int32)
+        succ_off = np.ascontiguousarray(succ_off, dtype=np.int32)
+        succ = np.ascontiguousarray(succ, dtype=np.int32)
+        self.ntasks = int(indeg.shape[0])
+        pprio = None
+        if prio is not None:
+            prio = np.ascontiguousarray(prio, dtype=np.int64)
+            pprio = prio.ctypes.data_as(i64p)
+        h = lib.pt_dag_new(self.ntasks, indeg.ctypes.data_as(i32p),
+                           succ_off.ctypes.data_as(i32p),
+                           succ.ctypes.data_as(i32p), pprio)
+        super().__init__(lib, h, "pt_dag_free")
+        self._fetch = lib.pt_dag_fetch
+        self._complete = lib.pt_dag_complete
+
+    def fetch(self, buf, cap: int) -> int:
+        return self._fetch(self._h, buf, cap)
+
+    def complete(self, buf, n: int) -> int:
+        rem = self._complete(self._h, buf, n)
+        if rem < 0:
+            raise RuntimeError("compiled DAG successor counter underflow "
+                               "(inconsistent task graph)")
+        return rem
+
+    def remaining(self) -> int:
+        return self._lib.pt_dag_remaining(self._h)
 
 
 class NativeCounter(_Handle):

@@ -328,6 +328,104 @@ long pt_deptable_count(void* h) {
 }
 
 // ---------------------------------------------------------------------------
+// DAG executor: the select→release inner loop of a *compiled* task graph
+// (the jdf2c stance applied to the scheduler: scheduling.c:562-575's hot loop
+// over a concretely-enumerated DAG).  Python hands over indegree counts and a
+// CSR successor table once, then ping-pongs batches: pt_dag_fetch fills a
+// buffer of ready task ids (priority order when priorities exist), Python
+// runs the chore bodies, pt_dag_complete releases all successors of the
+// batch natively and banks the newly-ready set.  Per-task native cost is a
+// few array ops; Python appears only at the chore boundary.
+// ---------------------------------------------------------------------------
+struct Dag {
+    Spin lock;
+    int32_t ntasks;
+    int64_t remaining;           // tasks not yet completed
+    std::vector<int32_t> indeg;  // live remaining-input counters
+    std::vector<int32_t> succ_off;
+    std::vector<int32_t> succ;
+    std::vector<int64_t> prio;
+    bool use_prio;
+    std::vector<int32_t> ready;                          // LIFO when !use_prio
+    std::priority_queue<std::pair<int64_t, int32_t>> pready;
+};
+
+void* pt_dag_new(int32_t ntasks, const int32_t* indeg,
+                 const int32_t* succ_off, const int32_t* succ,
+                 const int64_t* prio) {
+    Dag* d = new Dag();
+    d->ntasks = ntasks;
+    d->remaining = ntasks;
+    d->indeg.assign(indeg, indeg + ntasks);
+    d->succ_off.assign(succ_off, succ_off + ntasks + 1);
+    d->succ.assign(succ, succ + succ_off[ntasks]);
+    d->use_prio = (prio != nullptr);
+    if (prio) d->prio.assign(prio, prio + ntasks);
+    for (int32_t i = 0; i < ntasks; i++) {
+        if (d->indeg[i] == 0) {
+            if (d->use_prio) d->pready.emplace(d->prio[i], i);
+            else d->ready.push_back(i);
+        }
+    }
+    return d;
+}
+
+void pt_dag_free(void* h) { delete (Dag*)h; }
+
+// Fill out[0..cap) with ready task ids; returns the count (0 = none ready).
+int32_t pt_dag_fetch(void* h, int32_t* out, int32_t cap) {
+    Dag* d = (Dag*)h;
+    d->lock.lock();
+    int32_t n = 0;
+    if (d->use_prio) {
+        while (n < cap && !d->pready.empty()) {
+            out[n++] = d->pready.top().second;
+            d->pready.pop();
+        }
+    } else {
+        while (n < cap && !d->ready.empty()) {
+            out[n++] = d->ready.back();
+            d->ready.pop_back();
+        }
+    }
+    d->lock.unlock();
+    return n;
+}
+
+// Complete a batch: release every successor edge, banking newly-ready tasks.
+// Returns the number of tasks still outstanding (0 = DAG fully executed),
+// or -1 if a successor counter underflowed (graph inconsistency).
+int64_t pt_dag_complete(void* h, const int32_t* done, int32_t n) {
+    Dag* d = (Dag*)h;
+    d->lock.lock();
+    for (int32_t j = 0; j < n; j++) {
+        int32_t t = done[j];
+        for (int32_t e = d->succ_off[t]; e < d->succ_off[t + 1]; e++) {
+            int32_t s = d->succ[e];
+            if (--d->indeg[s] == 0) {
+                if (d->use_prio) d->pready.emplace(d->prio[s], s);
+                else d->ready.push_back(s);
+            } else if (d->indeg[s] < 0) {
+                d->lock.unlock();
+                return -1;
+            }
+        }
+    }
+    d->remaining -= n;
+    int64_t rem = d->remaining;
+    d->lock.unlock();
+    return rem;
+}
+
+int64_t pt_dag_remaining(void* h) {
+    Dag* d = (Dag*)h;
+    d->lock.lock();
+    int64_t r = d->remaining;
+    d->lock.unlock();
+    return r;
+}
+
+// ---------------------------------------------------------------------------
 // atomic counter with zero detection (the nb_tasks/nb_pending_actions
 // discipline: the transition TO zero must be observed exactly once)
 // ---------------------------------------------------------------------------

@@ -117,6 +117,22 @@ class Context:
             self._tp_by_comm_id[tp.comm_id] = tp
         if tp.on_enqueue is not None:
             tp.on_enqueue(tp)
+        # compiled-DAG incarnation: enumerable single-rank PTG pools skip the
+        # scheduler entirely (dagrun.py — the scheduling.c:562 loop, native)
+        from .dagrun import compile_taskpool_dag
+        dag = compile_taskpool_dag(tp, self)
+        if dag is not None:
+            # account BEFORE publishing: an idle worker may claim and finish
+            # the dag the instant _compiled_dag is visible, and its -ntasks
+            # must not land on a zero counter
+            tp.tdm.taskpool_addto_nb_tasks(dag.ntasks)
+            tp.tdm.ready()
+            tp._compiled_dag = dag
+            if self.comm_engine is not None:
+                self.comm_engine.taskpool_registered(tp)
+            with self._cond:
+                self._cond.notify_all()   # wake a mid-wait driving thread
+            return
         n = tp.nb_local_tasks()
         if n >= 0:
             tp.tdm.taskpool_addto_nb_tasks(n)
@@ -188,14 +204,17 @@ class Context:
         while True:
             if self._shutdown:
                 return
-            task, distance = select_task(es)
-            if task is None:
-                if self.comm_engine is not None and es.th_id == 0:
-                    self.comm_engine.progress(es)
-                backoff.wait()
-                continue
-            backoff.reset()
             try:
+                task, distance = select_task(es)
+                if task is None:
+                    # idle worker: claim a compiled-DAG pool if one waits
+                    # (keeps start()+test()-polling callers progressing)
+                    self._run_compiled_dags(es)
+                    if self.comm_engine is not None and es.th_id == 0:
+                        self.comm_engine.progress(es)
+                    backoff.wait()
+                    continue
+                backoff.reset()
                 task_progress(es, task, distance)
             except BaseException as e:   # surface to waiters, don't hang
                 with self._lock:
@@ -212,26 +231,39 @@ class Context:
         inline (master-thread funneled mode)."""
         if not self.started:
             self.start()
+        deadline = None if timeout is None else time.monotonic() + timeout
         if self._threads:
-            with self._cond:
-                ok = self._cond.wait_for(
-                    lambda: predicate() or self._worker_error is not None,
-                    timeout)
-                if self._worker_error is not None:
-                    raise RuntimeError(
-                        "a worker thread failed") from self._worker_error
-                if not ok:
-                    raise TimeoutError("context wait timed out")
-            return
+            while True:
+                self._run_compiled_dags(deadline=deadline)
+                with self._cond:
+                    if self._worker_error is not None:
+                        raise RuntimeError(
+                            "a worker thread failed") from self._worker_error
+                    if predicate():
+                        return
+                    rem = None if deadline is None else \
+                        deadline - time.monotonic()
+                    if rem is not None and rem <= 0:
+                        raise TimeoutError("context wait timed out")
+                    # wake on termination, worker error, or a freshly
+                    # enqueued compiled-DAG pool needing this driver
+                    ok = self._cond.wait_for(
+                        lambda: predicate()
+                        or self._worker_error is not None
+                        or self._has_pending_dag(), rem)
+                    if not ok:
+                        raise TimeoutError("context wait timed out")
+        self._run_compiled_dags(deadline=deadline)
         es = self._submit_es
         es.owner_ident = threading.get_ident()
         backoff = Backoff()
-        deadline = None if timeout is None else time.monotonic() + timeout
         while not predicate():
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError("context wait timed out")
             task, distance = select_task(es)
             if task is None:
+                # pools enqueued mid-drive
+                self._run_compiled_dags(deadline=deadline)
                 if self.comm_engine is not None:
                     self.comm_engine.progress(es)
                 if predicate():
@@ -240,6 +272,53 @@ class Context:
                 continue
             backoff.reset()
             task_progress(es, task, distance)
+
+    def _has_pending_dag(self) -> bool:
+        """A compiled pool still waiting for a driver (claimed-and-running
+        pools don't count: their driver will notify on completion).  Binds
+        each dag once: a driver may null ``_compiled_dag`` concurrently."""
+        return any(dag is not None and dag.pending
+                   for dag in (getattr(tp, "_compiled_dag", None)
+                               for tp in self._active_taskpools))
+
+    def _run_compiled_dags(self, es: Any = None,
+                           deadline: float | None = None) -> None:
+        """Drive any compiled-DAG taskpools to completion from this thread.
+
+        Compiled pools are funneled: one thread (the waiter, or an idle
+        worker) claims the pool and runs the fetch/execute/complete loop —
+        the master-thread progress path, with select/release native
+        (dagrun.py).  Python bodies hold the GIL, so a single driver loses
+        nothing over the worker pool.  A ``deadline`` expiry leaves the pool
+        unclaimed and resumable and raises TimeoutError."""
+        with self._lock:
+            pending = [tp for tp in self._active_taskpools
+                       if getattr(tp, "_compiled_dag", None) is not None]
+        for tp in pending:
+            dag = getattr(tp, "_compiled_dag", None)
+            if dag is None or not dag.claim():
+                continue
+            try:
+                finished = dag.run(
+                    es if es is not None else self._submit_es, deadline)
+            except BaseException as e:
+                # record the failure BEFORE terminating the pool: a waiter
+                # woken by the termination must see the error, not success
+                with self._lock:
+                    if self._worker_error is None:
+                        self._worker_error = e
+                tp._compiled_dag = None
+                tp.tdm.taskpool_addto_nb_tasks(-dag.ntasks)
+                raise
+            if not finished:
+                # dag.run yielded: deadline expiry, or an all-AGAIN pass
+                # waiting on another pool's progress.  The pool stays
+                # pending and resumable either way.
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError("context wait timed out")
+                continue
+            tp._compiled_dag = None
+            tp.tdm.taskpool_addto_nb_tasks(-dag.ntasks)
 
     # ----------------------------------------------------------- internals
     def _taskpool_terminated(self, tp: Taskpool) -> None:

@@ -140,6 +140,15 @@ class TaskClass:
         self.complete_execution = complete_execution
         self.repo = None                  # DataRepo, attached by the taskpool
         self.dependencies_goal = 0        # unused for guarded classes
+        # make_key on the C path: itemgetter over the param names
+        from operator import itemgetter
+        if len(self.params) >= 2:
+            self._keyget = itemgetter(*self.params)
+        elif len(self.params) == 1:
+            g = itemgetter(self.params[0])
+            self._keyget = lambda d: (g(d),)
+        else:
+            self._keyget = lambda d: ()
         # precomputed (flow_index, dep_index) -> bit position (hot path)
         self._dep_bits: dict[tuple[int, int], int] = {}
         bit = 0
@@ -151,7 +160,7 @@ class TaskClass:
     # -- keys ---------------------------------------------------------------
     def make_key(self, locals_: dict) -> tuple:
         """Canonical task key (cf. generated ``make_key`` fns)."""
-        return tuple(locals_[p] for p in self.params)
+        return self._keyget(locals_)
 
     # -- dep structure ------------------------------------------------------
     def input_dep_mask(self, locals_: dict) -> int:
