@@ -131,6 +131,48 @@ register_kernel("gemm_nt", "tpu", gemm_nt_tpu_body)
 
 
 # ---------------------------------------------------------------------------
+# traceable incarnations (the compiled-lowering side of the dyld names:
+# pure functions of the flow values, in flow declaration order)
+# ---------------------------------------------------------------------------
+
+
+def _potrf_traceable(t):
+    _, jnp, _ = _jax()
+    return jnp.linalg.cholesky(t.astype(jnp.float32))
+
+
+def _trsm_traceable(lkk, c):
+    _, jnp, jsl = _jax()
+    return jsl.solve_triangular(lkk.astype(jnp.float32),
+                                c.astype(jnp.float32).T, lower=True).T
+
+
+def _syrk_traceable(a, t):
+    _, jnp, _ = _jax()
+    a = a.astype(jnp.float32)
+    return t.astype(jnp.float32) - jnp.dot(
+        a, a.T, preferred_element_type=jnp.float32)
+
+
+def _gemm_nt_traceable(a, b, c):
+    _, jnp, _ = _jax()
+    return c.astype(jnp.float32) - jnp.dot(
+        a.astype(jnp.float32), b.astype(jnp.float32).T,
+        preferred_element_type=jnp.float32)
+
+
+def _register_traceables() -> None:
+    from ..ptg.lowering import register_traceable
+    register_traceable("potrf", _potrf_traceable)
+    register_traceable("trsm_rlt", _trsm_traceable)
+    register_traceable("syrk_ln", _syrk_traceable)
+    register_traceable("gemm_nt", _gemm_nt_traceable)
+
+
+_register_traceables()
+
+
+# ---------------------------------------------------------------------------
 # the PTG
 # ---------------------------------------------------------------------------
 

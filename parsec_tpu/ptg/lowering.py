@@ -85,11 +85,23 @@ class Traceable:
 def _default_bilinear_chain(lhs: Any, rhs: Any, acc0: Any) -> Any:
     """Collapse an accumulation chain: ``acc0[m,n] + sum_k lhs[m,k]·rhs[k,n]``
     over tile stacks — one dot_general contracting (k, tile-k), which XLA
-    lays out as a full-size MXU matmul."""
+    lays out as a full-size MXU matmul.
+
+    Honors the ``gemm_precision`` MCA param exactly like the dynamic-path
+    kernel (``ops/gemm.py``): ``highest`` forces full-precision multiplies
+    on TPU, where the default would run f32 tiles through bf16 MXU passes
+    and diverge from the dynamic runtime's CPU-f32 results."""
+    import jax
     import jax.numpy as jnp
 
+    from ..core.params import params as _cparams
+    try:
+        prec = (jax.lax.Precision.HIGHEST
+                if _cparams.get("gemm_precision") == "highest" else None)
+    except KeyError:
+        prec = None
     acc = jnp.einsum("mkab,knbc->mnac", lhs, rhs,
-                     preferred_element_type=jnp.float32)
+                     preferred_element_type=jnp.float32, precision=prec)
     return (acc0.astype(jnp.float32) + acc).astype(acc0.dtype)
 
 
@@ -168,13 +180,23 @@ class _Stores:
     (``[n_tiles, h, w]``, supports arbitrary gathers) or ``dense`` (the
     whole matrix ``[lm, ln]``, chosen when a pass proves its accesses form
     the identity tile grid — the fused program then reads the operand in
-    its natural layout with zero gather/relayout cost)."""
+    its natural layout with zero gather/relayout cost).
 
-    def __init__(self):
+    With ``nranks`` set (multi-rank lowering), stacked stores are laid out
+    **rank-major**: the tiles rank *r* owns (``dc.rank_of``) occupy the
+    contiguous row slab ``[r*cap, (r+1)*cap)``, zero-padded to the largest
+    per-rank count — so sharding axis 0 over a ``ranks`` mesh axis places
+    every tile exactly on its owning device, and cross-rank dep edges
+    surface as XLA gathers that GSPMD lowers to collectives."""
+
+    def __init__(self, nranks: int | None = None):
         self.dcs: dict[str, Any] = {}
         self.rows: dict[str, dict[tuple, int]] = {}
         self.written: set[str] = set()
         self.layout: dict[str, str] = {}
+        self.nranks = nranks
+        self.nrows: dict[str, int] = {}     # total rows incl. padding
+        self.replicated: set[str] = set()   # nodes==1 collections
 
     def row(self, dc, key: tuple) -> int:
         name = dc.name
@@ -188,7 +210,26 @@ class _Stores:
                     f"collection {name} has ragged tiles {shapes}; "
                     f"lowering needs uniform tile shapes")
             self.dcs[name] = dc
-            self.rows[name] = {k: i for i, k in enumerate(keys)}
+            if self.nranks is not None and getattr(dc, "nodes", 1) > 1:
+                if dc.nodes != self.nranks:
+                    raise LoweringError(
+                        f"collection {name} is distributed over {dc.nodes} "
+                        f"ranks but the mesh has {self.nranks}")
+                by_rank: dict[int, list[tuple]] = {}
+                for k in keys:
+                    by_rank.setdefault(dc.rank_of(*k), []).append(k)
+                cap = max(len(v) for v in by_rank.values())
+                rows: dict[tuple, int] = {}
+                for r in range(self.nranks):
+                    for i, k in enumerate(by_rank.get(r, ())):
+                        rows[k] = r * cap + i
+                self.rows[name] = rows
+                self.nrows[name] = self.nranks * cap
+            else:
+                self.rows[name] = {k: i for i, k in enumerate(keys)}
+                self.nrows[name] = len(keys)
+                if self.nranks is not None:
+                    self.replicated.add(name)
             self.layout[name] = "stacked"
         try:
             return self.rows[name][key]
@@ -200,6 +241,8 @@ class _Stores:
         whole collection: ``I[i, j] == row of tile (i, j)``, every tile
         covered.  Pure check; commit with ``set_dense``."""
         name = dc.name
+        if self.nranks is not None:
+            return False   # dense re-layout would discard tile ownership
         if not (hasattr(dc, "mt") and hasattr(dc, "nt")):
             return False
         if I.shape != (dc.mt, dc.nt):
@@ -215,16 +258,20 @@ class _Stores:
 
     def materialize(self) -> dict[str, Any]:
         """Gather tiles into host arrays (device placement is the caller's
-        business — jit will device_put on first call)."""
+        business — jit will device_put on first call).  Rank-major stores
+        zero-fill their padding rows."""
         out = {}
         for name, dc in self.dcs.items():
             if self.layout[name] == "dense":
                 out[name] = dc.to_dense()
                 continue
-            keys = sorted(self.rows[name], key=self.rows[name].get)
-            tiles = [np.asarray(dc.data_of(*k).newest_copy().value)
-                     for k in keys]
-            out[name] = np.stack(tiles)
+            rows = self.rows[name]
+            first = np.asarray(
+                dc.data_of(*next(iter(rows))).newest_copy().value)
+            arr = np.zeros((self.nrows[name],) + first.shape, first.dtype)
+            for k, i in rows.items():
+                arr[i] = np.asarray(dc.data_of(*k).newest_copy().value)
+            out[name] = arr
         return out
 
     def writeback(self, values: dict[str, Any]) -> None:
@@ -568,13 +615,23 @@ class LoweredTaskpool:
     one full taskpool execution; jit it, scan it, shard it.
     ``execute()``: convenience — run once on device and write tiles back to
     the source collections (the dynamic path's completion semantics).
+
+    With ``mesh`` set (multi-rank lowering), execution jits with
+    ``in_shardings``/``out_shardings`` derived from the collections' own
+    distributions (:meth:`shardings`): every tile lives on the device its
+    ``rank_of`` names, and GSPMD inserts the collectives that the dynamic
+    runtime's remote-dep protocol would have performed — the compiled
+    incarnation of SURVEY §7's "parallelism is a derived schedule on the
+    dataflow core".
     """
 
-    def __init__(self, tp, step_fn, stores: _Stores, mode: str) -> None:
+    def __init__(self, tp, step_fn, stores: _Stores, mode: str,
+                 mesh: Any = None) -> None:
         self.taskpool = tp
         self.step_fn = step_fn
         self._stores = stores
         self.mode = mode    # "chain-collapse" | "unrolled"
+        self.mesh = mesh    # jax Mesh with a "ranks" axis, or None
         self._jitted = None
 
     def initial_stores(self) -> dict[str, Any]:
@@ -584,30 +641,62 @@ class LoweredTaskpool:
     def written_collections(self) -> set[str]:
         return set(self._stores.written)
 
+    def shardings(self) -> dict[str, Any]:
+        """Per-store NamedSharding over the ``ranks`` mesh axis: rank-major
+        stacked stores shard axis 0 (each slab on its owner), replicated
+        (nodes==1) collections replicate."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        assert self.mesh is not None
+        out = {}
+        for name in self._stores.dcs:
+            spec = P() if name in self._stores.replicated else P("ranks")
+            out[name] = NamedSharding(self.mesh, spec)
+        return out
+
     def execute(self) -> dict[str, Any]:
         import jax
         if self._jitted is None:
-            self._jitted = jax.jit(self.step_fn)
+            if self.mesh is not None:
+                sh = self.shardings()
+                self._jitted = jax.jit(self.step_fn, in_shardings=(sh,),
+                                       out_shardings=sh)
+            else:
+                self._jitted = jax.jit(self.step_fn)
         out = self._jitted(self.initial_stores())
         self._stores.writeback(out)
         return out
 
 
-def lower_taskpool(tp, context: Any = None) -> LoweredTaskpool:
+def lower_taskpool(tp, context: Any = None,
+                   mesh: Any = None) -> LoweredTaskpool:
     """Lower a regular PTG taskpool to one XLA program.
+
+    ``mesh``: a :class:`jax.sharding.Mesh` with one ``"ranks"`` axis — lowers
+    the *distributed* taskpool to a single SPMD program over that mesh, tile
+    ownership taken from each collection's ``rank_of`` (the distribution the
+    dynamic runtime would route remote deps by).
 
     Raises :class:`LoweringError` when the structure is not lowerable; the
     caller then runs the dynamic scheduler instead (same taskpool object).
     """
-    if context is not None and getattr(context, "nb_ranks", 1) > 1:
-        raise LoweringError("multi-rank lowering goes through shard_map "
-                            "(parsec_tpu.parallel); dynamic path here")
+    nranks = None
+    if mesh is not None:
+        axes = dict(getattr(mesh, "shape", {}))
+        if list(axes) != ["ranks"]:
+            raise LoweringError(
+                f"multi-rank lowering needs a 1-D mesh with a 'ranks' axis, "
+                f"got {list(axes)}")
+        nranks = axes["ranks"]
+    elif context is not None and getattr(context, "nb_ranks", 1) > 1:
+        raise LoweringError("multi-rank lowering needs an explicit mesh= "
+                            "(see lower_taskpool docstring); dynamic path "
+                            "here")
     infos = _analyze(tp)
-    stores = _Stores()
+    stores = _Stores(nranks)
     step = _try_chain_collapse(tp, infos, stores)
     mode = "chain-collapse"
     if step is None:
-        stores = _Stores()
+        stores = _Stores(nranks)
         step = _build_unrolled(tp, infos, stores)
         mode = "unrolled"
-    return LoweredTaskpool(tp, step, stores, mode)
+    return LoweredTaskpool(tp, step, stores, mode, mesh=mesh)

@@ -148,16 +148,19 @@ class _CompiledDagBase:
                     break
                 backoff.reset()
             elif retry:
-                # a full AGAIN pass made no progress: back off, and after a
-                # few such passes yield the driving thread entirely — an
-                # AGAIN body may be waiting on another taskpool's progress
+                # a full AGAIN pass made no progress: back off FIRST (so a
+                # re-claiming waiter is paced by the growing backoff, never
+                # a hot spin), then after a few such passes yield the
+                # driving thread entirely — an AGAIN body may be waiting on
+                # another taskpool's progress
                 self._noprog += 1
+                backoff.wait()
                 if self._noprog >= 3:
+                    self._noprog = 0
                     self._carry = retry
                     with self._lock:
                         self._claimed = False
                     return False
-                backoff.wait()
         self.done = True
         return True
 

@@ -1,0 +1,137 @@
+"""Multi-rank compiled lowering: one SPMD XLA program from a distributed PTG.
+
+VERDICT r2 item 7: ``lower_taskpool(tp, mesh=...)`` lowers a block-cyclic
+distributed taskpool to a single sharded program — tile ownership taken from
+the collections' ``rank_of``, collectives inserted by GSPMD.  Adversarial
+checks: the lowered result must equal (a) the dense reference, and (b) the
+*dynamic* multi-rank runtime executing the same taskpool over the comm
+engine.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from parsec_tpu.comm import run_multirank
+from parsec_tpu.data_dist.matrix import SymTwoDimBlockCyclic, TwoDimBlockCyclic
+from parsec_tpu.models.cholesky import make_spd, tiled_cholesky_ptg
+from parsec_tpu.models.tiled_gemm import tiled_gemm_ptg
+from parsec_tpu.ptg.lowering import LoweringError, lower_taskpool
+
+
+def mesh_of(nranks: int) -> Mesh:
+    return Mesh(np.array(jax.devices()[:nranks]), ("ranks",))
+
+
+def assemble(dc) -> np.ndarray:
+    """Full dense matrix from ALL tiles (to_dense() keeps only the local
+    rank's tiles on distributed collections; the lowered store holds every
+    tile in-process)."""
+    out = np.zeros((dc.lm, dc.ln), dtype=dc.dtype)
+    for m in range(dc.mt):
+        for n in range(dc.nt):
+            if not dc.has_tile(m, n):
+                continue
+            t = np.asarray(dc.data_of(m, n).newest_copy().value)
+            out[m * dc.mb:m * dc.mb + t.shape[0],
+                n * dc.nb:n * dc.nb + t.shape[1]] = t
+    return out
+
+
+def build_gemm(nranks: int, n=64, nb=16, seed=7):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(n, n).astype(np.float32)
+    b = rng.randn(n, n).astype(np.float32)
+    P = 2 if nranks % 2 == 0 else 1
+    Q = nranks // P
+    A = TwoDimBlockCyclic.from_dense("A", a, nb, nb, P=P, Q=Q)
+    B = TwoDimBlockCyclic.from_dense("B", b, nb, nb, P=P, Q=Q)
+    C = TwoDimBlockCyclic("C", n, n, nb, nb, P=P, Q=Q)
+    return a, b, A, B, C
+
+
+@pytest.mark.parametrize("nranks", [2, 4, 8])
+def test_lowered_gemm_matches_dense(nranks):
+    a, b, A, B, C = build_gemm(nranks)
+    low = lower_taskpool(tiled_gemm_ptg(A, B, C), mesh=mesh_of(nranks))
+    assert low.mode == "chain-collapse"
+    low.execute()
+    np.testing.assert_allclose(assemble(C), a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_lowered_gemm_tiles_live_on_owner_ranks():
+    """The sharding contract: rank-major slabs — row // cap == rank_of."""
+    a, b, A, B, C = build_gemm(4)
+    low = lower_taskpool(tiled_gemm_ptg(A, B, C), mesh=mesh_of(4))
+    st = low._stores
+    for name, rows in st.rows.items():
+        dc = st.dcs[name]
+        cap = st.nrows[name] // 4
+        for key, row in rows.items():
+            assert row // cap == dc.rank_of(*key), (name, key)
+    sh = low.shardings()
+    assert all(s.spec == ("ranks",) or s.spec == () for s in sh.values())
+
+
+def test_lowered_gemm_matches_dynamic_multirank():
+    """The compiled incarnation against the dynamic runtime on 4 inproc
+    ranks (same taskpool shape, remote deps through the comm engine)."""
+    nranks = 4
+    a, b, A, B, C = build_gemm(nranks)
+    low = lower_taskpool(tiled_gemm_ptg(A, B, C), mesh=mesh_of(nranks))
+    low.execute()
+    lowered = assemble(C)
+
+    def body(ctx, rank, nr):
+        a2, b2, A2, B2, C2 = build_gemm(nr)
+        for dc in (A2, B2, C2):
+            dc.myrank = rank
+        tp = tiled_gemm_ptg(A2, B2, C2, devices="cpu")
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+        ctx.comm_barrier()
+        return C2.to_dense()
+
+    res = run_multirank(nranks, body)
+    dynamic = np.zeros_like(lowered)
+    for r in res:
+        dynamic += r        # each rank contributes only the tiles it owns
+    np.testing.assert_allclose(lowered, dynamic, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(lowered, a @ b, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("nranks", [2, 4])
+def test_lowered_cholesky_unrolled_multirank(nranks):
+    """Four task classes, triangular space, range arrows — the unrolled
+    lowering pass, sharded.  POTRF/TRSM/SYRK/GEMM traceables drive it."""
+    n, nb = 64, 16
+    spd = make_spd(n)
+    A = SymTwoDimBlockCyclic.from_dense("A", spd, nb, nb,
+                                        P=nranks, Q=1)
+    tp = tiled_cholesky_ptg(A)
+    low = lower_taskpool(tp, mesh=mesh_of(nranks))
+    assert low.mode == "unrolled"
+    low.execute()
+    got = np.tril(assemble(A))
+    expect = np.linalg.cholesky(spd.astype(np.float64))
+    np.testing.assert_allclose(got, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_lowered_cholesky_single_rank():
+    n, nb = 64, 16
+    spd = make_spd(n)
+    A = SymTwoDimBlockCyclic.from_dense("A", spd, nb, nb)
+    low = lower_taskpool(tiled_cholesky_ptg(A))
+    assert low.mode == "unrolled"
+    low.execute()
+    got = np.tril(A.to_dense())
+    np.testing.assert_allclose(got, np.linalg.cholesky(spd.astype(np.float64)),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_mesh_axis_name_is_checked():
+    a, b, A, B, C = build_gemm(2)
+    bad = Mesh(np.array(jax.devices()[:2]), ("x",))
+    with pytest.raises(LoweringError):
+        lower_taskpool(tiled_gemm_ptg(A, B, C), mesh=bad)
