@@ -1,6 +1,6 @@
 """Parallelism packs: SPMD lowerings + distributed schedule recipes
 (SURVEY §2.12: DP/TP/PP/SP/EP as first-class derived schedules)."""
 
-from . import train
+from . import expert, pipeline, train
 
-__all__ = ["train"]
+__all__ = ["train", "pipeline", "expert"]

@@ -1,0 +1,207 @@
+"""Expert parallelism (EP) on the dataflow core and on the mesh.
+
+SURVEY §2.12's missing EP recipe: a mixture-of-experts feed-forward whose
+*experts* are placed by an arbitrary tile→rank table — the
+:class:`~parsec_tpu.data_dist.matrix.TwoDimTabular` distribution
+(``/root/reference/parsec/data_dist/matrix/two_dim_tabular.c``), exactly the
+substrate the reference provides for irregular placements.
+
+Static-capacity top-1 routing as a three-class PTG (:func:`moe_ptg`):
+
+- ``GATE(b)`` on the rank owning token block ``b``: computes the router
+  argmax and packs, for every expert ``e``, a fixed-capacity buffer
+  ``[cap, 1+d]`` — column 0 the originating token row (``-1`` pads),
+  columns 1: the token values.  One guarded output dep per ``(b, e)`` pair
+  forms the static all-to-all, each buffer shipping to wherever the table
+  put its expert.
+- ``EXPERT(e)`` on ``rank_table(e)``: applies its FFN to the value columns
+  of every incoming buffer; the index column rides along.
+- ``COMBINE(b)`` back on ``b``'s rank: scatters expert outputs to their
+  original rows by the carried indices and writes the result tile.
+
+The routing *decision* is data (the index column), never graph structure —
+all shapes and edges are static, which is what keeps the recipe lowerable
+and TPU-friendly.
+
+The mesh-side incarnation (:func:`make_moe_step`) is the standard dense
+one-hot dispatch/combine einsum pair over an ``ep`` mesh axis: experts
+shard, GSPMD turns the dispatch contraction into the all-to-all.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from .. import ptg
+from ..data_dist.matrix import TwoDimTabular
+
+__all__ = ["moe_ptg", "reference_moe", "make_moe_step"]
+
+
+# ---------------------------------------------------------------------------
+# routing kernels (CPU bodies; pure, reused by the tests)
+# ---------------------------------------------------------------------------
+
+def _gate_pack(x: np.ndarray, wg: np.ndarray, nexperts: int,
+               cap: int) -> list[np.ndarray]:
+    """Top-1 route: per-expert ``[cap, 1+d]`` packed buffers."""
+    d = x.shape[1]
+    sel = np.argmax(x @ wg, axis=1)
+    out = []
+    for e in range(nexperts):
+        buf = np.full((cap, 1 + d), -1.0, dtype=np.float32)
+        rows = np.flatnonzero(sel == e)[:cap]
+        buf[:len(rows), 0] = rows.astype(np.float32)
+        buf[:len(rows), 1:] = x[rows]
+        out.append(buf)
+    return out
+
+
+def _expert_apply(buf: np.ndarray, w: np.ndarray) -> np.ndarray:
+    """FFN on the value columns; the index column rides along."""
+    out = np.array(buf, dtype=np.float32)
+    valid = out[:, 0] >= 0
+    h = np.maximum(out[:, 1:] @ w, 0.0)          # relu(x @ W_e)
+    out[:, 1:] = np.where(valid[:, None], h, out[:, 1:])
+    return out
+
+
+def reference_moe(x: np.ndarray, wg: np.ndarray,
+                  we: np.ndarray) -> np.ndarray:
+    """Dense reference: top-1 routed relu(x @ W_sel) per token."""
+    sel = np.argmax(x @ wg, axis=1)
+    y = np.zeros_like(x, dtype=np.float32)
+    for i, e in enumerate(sel):
+        y[i] = np.maximum(x[i] @ we[e], 0.0)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# the dataflow-core recipe
+# ---------------------------------------------------------------------------
+
+def moe_ptg(X: Any, W: TwoDimTabular, wg: np.ndarray, nexperts: int,
+            name: str = "moe") -> "ptg.PTGTaskpool":
+    """Build the EP PTG.
+
+    ``X``: token-block collection — ``X(b, 0)`` is a ``[ntok, d]`` tile;
+    outputs overwrite it.  ``W``: expert weights, one tile per expert —
+    ``W.rank_of(e, 0)`` IS the expert placement.  ``wg``: the replicated
+    ``[d, nexperts]`` router matrix.
+
+    Flow-name convention (the static all-to-all): GATE's buffer flow
+    ``B<e>`` targets EXPERT's ``X<b>`` — the target flow name depends on
+    the *source* task's local, so each (b, e) pair gets its own guarded
+    dep (``guard: l.b == b``); exactly one is active per instance.
+    """
+    B, E = X.mt, nexperts
+    cap = X.mb   # full capacity: top-1, no token dropping
+
+    p = ptg.PTGBuilder(name, X=X, W=W, WG=np.asarray(wg, np.float32),
+                       B=B, E=E, CAP=cap)
+
+    # ---- GATE(b) ----------------------------------------------------------
+    ga = p.task("GATE", b=ptg.span(0, lambda g, l: g.B - 1))
+    ga.affinity("X", lambda g, l: (l.b, 0))
+    ga.flow("T", ptg.READ).input(data=("X", lambda g, l: (l.b, 0)))
+    for e in range(E):
+        fb = ga.flow(f"B{e}", ptg.WRITE)
+        for b in range(B):
+            fb.output(succ=("EXPERT", f"X{b}",
+                            lambda g, l, e=e: {"e": e}),
+                      guard=lambda g, l, b=b: l.b == b)
+
+    def gate_body(es, task, g, l):
+        from ..data.data import data_create
+        x = np.asarray(task.flow_data("T").value, dtype=np.float32)
+        packed = _gate_pack(x, g.WG, g.E, g.CAP)
+        for e in range(g.E):
+            task.set_flow_data(
+                f"B{e}", data_create(
+                    packed[e],
+                    key=(task.taskpool.name, "g", l.b, e)).get_copy(0))
+
+    ga.body(gate_body)
+
+    # ---- EXPERT(e) --------------------------------------------------------
+    ex = p.task("EXPERT", e=ptg.span(0, lambda g, l: g.E - 1))
+    ex.affinity("W", lambda g, l: (l.e, 0))
+    ex.flow("WF", ptg.READ).input(data=("W", lambda g, l: (l.e, 0)))
+    for b in range(B):
+        fx = ex.flow(f"X{b}", ptg.RW)
+        for e in range(E):
+            fx.input(pred=("GATE", f"B{e}",
+                           lambda g, l, b=b: {"b": b}),
+                     guard=lambda g, l, e=e: l.e == e)
+        for e in range(E):
+            fx.output(succ=("COMBINE", f"R{e}",
+                            lambda g, l, b=b: {"b": b}),
+                      guard=lambda g, l, e=e: l.e == e)
+
+    def expert_body(es, task, g, l):
+        w = np.asarray(task.flow_data("WF").value, dtype=np.float32)
+        for b in range(g.B):
+            buf = task.flow_data(f"X{b}")
+            buf.value = _expert_apply(np.asarray(buf.value), w)
+            buf.version += 1
+
+    ex.body(expert_body)
+
+    # ---- COMBINE(b) -------------------------------------------------------
+    co = p.task("COMBINE", b=ptg.span(0, lambda g, l: g.B - 1))
+    co.affinity("X", lambda g, l: (l.b, 0))
+    cy = co.flow("Y", ptg.RW)
+    cy.input(data=("X", lambda g, l: (l.b, 0)))
+    cy.output(data=("X", lambda g, l: (l.b, 0)))
+    for e in range(E):
+        fr = co.flow(f"R{e}", ptg.READ)
+        for b in range(B):
+            fr.input(pred=("EXPERT", f"X{b}",
+                           lambda g, l, e=e: {"e": e}),
+                     guard=lambda g, l, b=b: l.b == b)
+
+    def combine_body(es, task, g, l):
+        y = task.flow_data("Y")
+        out = np.zeros_like(np.asarray(y.value), dtype=np.float32)
+        for e in range(g.E):
+            buf = np.asarray(task.flow_data(f"R{e}").value)
+            valid = buf[:, 0] >= 0
+            rows = buf[valid, 0].astype(np.int64)
+            out[rows] = buf[valid, 1:]
+        y.value = out
+        y.version += 1
+
+    co.body(combine_body)
+    return p.build()
+
+
+# ---------------------------------------------------------------------------
+# the mesh recipe (dense dispatch einsums over an "ep" axis)
+# ---------------------------------------------------------------------------
+
+def make_moe_step(mesh: Any) -> Any:
+    """Compile the dense-dispatch MoE step over an ``ep`` mesh axis.
+
+    ``step(x, wg, we)``: tokens ``[T, d]`` (replicated), router ``[d, E]``
+    (replicated), expert weights ``[E, d, d]`` sharded over ``ep``.  The
+    one-hot dispatch/combine einsums are what GSPMD lowers to the
+    all-to-all — the standard TPU MoE pattern.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def step(x, wg, we):
+        sel = jnp.argmax(x @ wg, axis=-1)                   # [T]
+        onehot = jax.nn.one_hot(sel, we.shape[0],
+                                dtype=x.dtype)              # [T, E]
+        xe = jnp.einsum("te,td->etd", onehot, x)            # dispatch
+        he = jax.nn.relu(jnp.einsum("etd,edf->etf", xe, we))
+        return jnp.einsum("te,etf->tf", onehot, he)         # combine
+
+    repl = NamedSharding(mesh, P())
+    shard_e = NamedSharding(mesh, P("ep"))
+    return jax.jit(step, in_shardings=(repl, repl, shard_e),
+                   out_shardings=repl)

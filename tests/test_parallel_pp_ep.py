@@ -1,0 +1,183 @@
+"""PP + EP recipes (VERDICT r2 item 8): pipeline-chain PTG across ranks
+(Ex03 shape) and expert routing over the TwoDimTabular distribution — each
+in both incarnations (dataflow core on 4 inproc ranks, and the mesh)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from parsec_tpu.comm import run_multirank
+from parsec_tpu.data_dist.matrix import TwoDimBlockCyclic, TwoDimTabular
+from parsec_tpu.parallel.expert import make_moe_step, moe_ptg, reference_moe
+from parsec_tpu.parallel.pipeline import make_pipeline_step, pipeline_ptg
+from parsec_tpu.runtime import Context
+
+
+# ---------------------------------------------------------------------------
+# PP — dataflow core
+# ---------------------------------------------------------------------------
+
+def _stage_fns(S):
+    """Distinct, non-commuting stages so ordering bugs surface."""
+    return [lambda x, s=s: x * (s + 2) + s for s in range(S)]
+
+
+def _expect_pipeline(x, fns):
+    for f in fns:
+        x = f(x)
+    return x
+
+
+def _pp_body(ctx, rank, nranks):
+    S, M, nb = 4, 6, 8
+    fns = _stage_fns(S)
+    X = TwoDimBlockCyclic("Xpp", lm=M * nb, ln=1, mb=nb, nb=1, P=1, Q=1,
+                          myrank=rank, nodes=nranks,
+                          init_fn=lambda m, n, sh:
+                          np.full(sh, float(m + 1), np.float32))
+    tp = pipeline_ptg(X, fns, nranks)
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=120)
+    ctx.comm_barrier()
+    if rank == 0:
+        return np.stack([np.asarray(X.data_of(m, 0).newest_copy().value)
+                         for m in range(M)])
+    return None
+
+
+@pytest.mark.parametrize("nranks", [1, 4])
+def test_pipeline_ptg_across_ranks(nranks):
+    res = run_multirank(nranks, _pp_body)
+    fns = _stage_fns(4)
+    for m in range(6):
+        expect = _expect_pipeline(np.full((8, 1), float(m + 1), np.float32),
+                                  fns)
+        np.testing.assert_allclose(res[0][m], expect)
+
+
+def test_pipeline_ptg_stage_placement():
+    """Affinity contract: stage s runs on rank s % nranks."""
+    seen = {}
+
+    def body(ctx, rank, nranks):
+        S, M, nb = 4, 2, 4
+        fns = [lambda x, s=s: (seen.setdefault((s, rank), True), x)[1]
+               for s in range(S)]
+        X = TwoDimBlockCyclic("Xsp", lm=M * nb, ln=1, mb=nb, nb=1,
+                              P=1, Q=1, myrank=rank, nodes=nranks,
+                              init_fn=lambda m, n, sh:
+                              np.zeros(sh, np.float32))
+        ctx.add_taskpool(pipeline_ptg(X, fns, nranks))
+        ctx.wait(timeout=120)
+        ctx.comm_barrier()
+
+    run_multirank(4, body)
+    assert set(seen) == {(s, s % 4) for s in range(4)}
+
+
+# ---------------------------------------------------------------------------
+# PP — mesh (shard_map + ppermute GPipe rotation)
+# ---------------------------------------------------------------------------
+
+def test_pipeline_mesh_matches_sequential():
+    import jax.numpy as jnp
+    S, M, nb, d = 4, 6, 2, 8
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    rng = np.random.RandomState(0)
+    w = rng.randn(S, d, d).astype(np.float32) * 0.3
+    xs = rng.randn(M, nb, d).astype(np.float32)
+
+    def stage_fn(wl, x):
+        return jnp.tanh(x @ wl)
+
+    run = make_pipeline_step(mesh, stage_fn, S, M)
+    ys = np.asarray(run(w, xs))
+
+    expect = xs.copy()
+    for s in range(S):
+        expect = np.tanh(expect @ w[s])
+    np.testing.assert_allclose(ys, expect, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# EP — dataflow core over TwoDimTabular
+# ---------------------------------------------------------------------------
+
+def _ep_setup(seed=0, B=2, E=4, ntok=16, d=8):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(B * ntok, d).astype(np.float32)
+    wg = rng.randn(d, E).astype(np.float32)
+    we = rng.randn(E, d, d).astype(np.float32)
+    return x, wg, we
+
+
+def _ep_body(ctx, rank, nranks):
+    B, E, ntok, d = 2, 4, 16, 8
+    x, wg, we = _ep_setup()
+    X = TwoDimBlockCyclic.from_dense("Xep", x, ntok, d, P=1, Q=1,
+                                     myrank=rank, nodes=nranks)
+    W = TwoDimTabular("Wep", lm=E * d, ln=d, mb=d, nb=d,
+                      rank_table=lambda m, n: m % nranks,
+                      nodes=nranks, myrank=rank,
+                      init_fn=lambda m, n, sh: we[m])
+    ctx.add_taskpool(moe_ptg(X, W, wg, E))
+    ctx.wait(timeout=120)
+    ctx.comm_barrier()
+    if rank == 0:
+        return np.concatenate(
+            [np.asarray(X.data_of(b, 0).newest_copy().value)
+             for b in range(B)])
+    return None
+
+
+@pytest.mark.parametrize("nranks", [1, 4])
+def test_moe_ptg_over_tabular(nranks):
+    x, wg, we = _ep_setup()
+    res = run_multirank(nranks, _ep_body)
+    expect = np.concatenate(
+        [reference_moe(x[b * 16:(b + 1) * 16], wg, we) for b in range(2)])
+    np.testing.assert_allclose(res[0], expect, rtol=1e-5, atol=1e-5)
+
+
+def test_moe_ptg_expert_placement():
+    """EXPERT(e) must execute on rank_table(e) — the tabular contract."""
+    seen = {}
+    B, E, ntok, d = 2, 4, 8, 4
+
+    def body(ctx, rank, nranks):
+        x, wg, we = _ep_setup(B=B, E=E, ntok=ntok, d=d)
+        X = TwoDimBlockCyclic.from_dense("Xpl", x, ntok, d, P=1, Q=1,
+                                         myrank=rank, nodes=nranks)
+        W = TwoDimTabular("Wpl", lm=E * d, ln=d, mb=d, nb=d,
+                          rank_table=lambda m, n: (m * 2 + 1) % nranks,
+                          nodes=nranks, myrank=rank,
+                          init_fn=lambda m, n, sh: we[m])
+        tp = moe_ptg(X, W, wg, E)
+        tc = tp.task_class("EXPERT")
+        orig = tc.chores[0].hook
+
+        def spy(es, task):
+            seen[(task.locals["e"], rank)] = True
+            return orig(es, task)
+        tc.chores[0].hook = spy
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+        ctx.comm_barrier()
+
+    run_multirank(4, body)
+    assert set(seen) == {(e, (e * 2 + 1) % 4) for e in range(4)}
+
+
+# ---------------------------------------------------------------------------
+# EP — mesh (dense dispatch einsums over "ep")
+# ---------------------------------------------------------------------------
+
+def test_moe_mesh_matches_reference():
+    E = 4
+    x, wg, we = _ep_setup(B=1, E=E, ntok=32, d=8)
+    mesh = Mesh(np.array(jax.devices()[:E]), ("ep",))
+    step = make_moe_step(mesh)
+    got = np.asarray(step(x, wg, we))
+    np.testing.assert_allclose(got, reference_moe(x, wg, we),
+                               rtol=1e-5, atol=1e-5)
