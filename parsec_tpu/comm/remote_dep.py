@@ -409,6 +409,8 @@ class RemoteDepEngine:
         wb = {d["flow_index"]: d.get("writeback", False)
               for d in msg["outputs"]}
 
+        from ..data.reshape import reshape_for_edge, reshape_for_writeback
+
         def visitor(t: Task, flow, dep) -> None:
             if flow.flow_index not in out_mask:
                 return
@@ -419,6 +421,7 @@ class RemoteDepEngine:
                     copy = copies.get(flow.flow_index)
                     dc, key = dep.data_ref(t.locals)
                     if copy is not None and dc.rank_of(*key) == self.my_rank:
+                        copy = reshape_for_writeback(copy, dep, dc, key)
                         apply_writeback_to_home(dc, key, copy)
                 return
             succ_tc = tp.task_class(dep.target_class)
@@ -428,9 +431,13 @@ class RemoteDepEngine:
                     continue
                 fi, di = _find_input_dep(succ_tc, dep.target_flow, tc.name,
                                          succ_locals)
+                # the wire carries the producer's type; a typed edge
+                # repacks on the read side (remote_dep.h:102-113 dtt_dst
+                # over dtt_src), lazily and shared per (copy, type)
+                send = reshape_for_edge(copies.get(flow.flow_index), dep,
+                                        succ_tc.flows[fi].deps_in[di])
                 rt = self.ctx.deps.release_dep(tp, succ_tc, succ_locals, fi,
-                                               di, copies.get(flow.flow_index),
-                                               None)
+                                               di, send, None)
                 if rt is not None:
                     ready.append(rt)
 

@@ -39,7 +39,7 @@ class DataCopy:
     """One device's copy of a datum (cf. ``parsec_data_copy_t``)."""
 
     __slots__ = ("original", "device_index", "coherency", "readers", "version",
-                 "value", "dtt", "flags", "arena_chunk")
+                 "value", "dtt", "flags", "arena_chunk", "reshaped")
 
     def __init__(self, original: "Data", device_index: int,
                  value: Any = None, dtt: TileType | None = None) -> None:
@@ -52,6 +52,7 @@ class DataCopy:
         self.dtt = dtt
         self.flags = 0
         self.arena_chunk = None  # owning arena, for recycling
+        self.reshaped = None     # dtt-key -> shared repack future (reshape.py)
 
     def __repr__(self) -> str:
         return (f"<DataCopy key={self.original.key} dev={self.device_index} "

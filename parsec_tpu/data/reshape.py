@@ -1,0 +1,138 @@
+"""Reshape-on-deps: lazy, shared repack of a copy to a dep's declared type.
+
+Rebuild of the reference's reshape system (``parsec/parsec_reshape.c:776``,
+``remote_dep.h:102-113``): a dependency may declare a datatype (``[type=...]``
+in JDF, ``dtt=`` in the DSL) different from the producer's copy, and the
+consumer must observe the datum *converted* to that type.
+
+Design — **read-side reshape**, one rule everywhere: conversion happens at
+the consuming edge (local release, collection read, remote receive,
+writeback), never at the producer.  The repack itself is
+
+- **lazy**: wrapped in a :class:`~parsec_tpu.core.future.DataCopyFuture`
+  resolved at ``prepare_input`` — the first consumer to run performs the
+  conversion on its own thread (the enable-callback protocol of
+  ``parsec_datacopy_future.c``);
+- **shared**: cached on the source copy keyed by the target type, so N
+  consumers of one datum with the same ``[type]`` pay one conversion
+  (the reference's per-repo-entry reshape cache).
+
+The conversion kernel is :func:`parsec_tpu.data.datatype.convert` — an XLA
+relayout (shape/dtype/layout), not an MPI datatype engine.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from ..core.future import DataCopyFuture
+from .data import DataCopy, data_create
+from .datatype import TileType, convert
+
+__all__ = ["needs_reshape", "reshaped_future", "resolve_copy", "edge_dtt",
+           "reshape_for_edge", "reshape_for_writeback"]
+
+
+def edge_dtt(out_dep: Any, in_dep: Any) -> TileType | None:
+    """The type a consumer edge wants: the input dep's declaration wins,
+    else the output dep's (``dtt_dst`` over ``dtt_src``)."""
+    want = getattr(in_dep, "dtt", None) if in_dep is not None else None
+    if want is None and out_dep is not None:
+        want = out_dep.dtt
+    return want
+
+
+def _copy_dtt(copy: DataCopy) -> TileType:
+    if copy.dtt is not None:
+        return copy.dtt
+    v = np.asarray(copy.value)
+    return TileType(tuple(v.shape), v.dtype)
+
+
+def needs_reshape(copy: DataCopy, want: TileType | None) -> bool:
+    if want is None:
+        return False
+    have = _copy_dtt(copy)
+    return (have.shape != want.shape
+            or np.dtype(have.dtype) != np.dtype(want.dtype)
+            or have.layout != want.layout)
+
+
+def _convert_copy(copy: DataCopy, want: TileType) -> DataCopy:
+    have = _copy_dtt(copy)
+    value = convert(copy.value, have, want)
+    if isinstance(copy.value, np.ndarray):
+        value = np.asarray(value)     # host tiles stay host-mutable
+    d = data_create(value, key=("reshape", copy.original.key,
+                                want.shape, str(np.dtype(want.dtype)),
+                                want.layout), dtt=want)
+    out = d.get_copy(0)
+    out.version = copy.version
+    return out
+
+
+_cache_lock = threading.Lock()
+
+
+def reshaped_future(copy: DataCopy, want: TileType) -> DataCopyFuture:
+    """Shared lazy repack future of ``copy`` to type ``want``.
+
+    The cache key includes the copy's *version*: an in-place mutation of
+    the source (a writeback, an RW body) bumps the version, so stale
+    completed conversions are never served; entries of older versions are
+    pruned on insert.  Creation is locked — N concurrent consumers share
+    exactly one conversion."""
+    key = (want.shape, str(np.dtype(want.dtype)), want.layout,
+           copy.version)
+    with _cache_lock:
+        cache = copy.reshaped
+        if cache is None:
+            cache = copy.reshaped = {}
+        f = cache.get(key)
+        if f is None:
+            for k in [k for k in cache if k[3] != copy.version]:
+                del cache[k]
+            f = DataCopyFuture(convert=lambda _src, c=copy, w=want:
+                               _convert_copy(c, w))
+            cache[key] = f
+    return f
+
+
+def resolve_copy(v: Any) -> Any:
+    """Materialize a reshape future (runs the conversion once, any thread)."""
+    if isinstance(v, DataCopyFuture):
+        v.trigger()
+        return v.get(timeout=60)
+    return v
+
+
+def reshape_for_edge(copy: Any, out_dep: Any, in_dep: Any) -> Any:
+    """The consumer-edge rule, shared by the local release path and the
+    remote receive path: return ``copy`` itself, or a lazy shared repack
+    future when the edge declares a different type."""
+    if copy is None:
+        return None
+    want = edge_dtt(out_dep, in_dep)
+    if needs_reshape(copy, want):
+        return reshaped_future(copy, want)
+    return copy
+
+
+def reshape_for_writeback(copy: DataCopy, dep: Any, dc: Any,
+                          key: tuple) -> DataCopy:
+    """The writeback rule, shared by the local and remote apply sites:
+    convert to the dep's declared type, or — when the dep is untyped but
+    the outgoing copy's type differs from the home tile's — back to the
+    home type (the reference reshapes writebacks to the original type;
+    an untyped writeback must never silently change a tile's shape)."""
+    want = dep.dtt if dep is not None else None
+    if want is None:
+        home = dc.data_of(*key).get_copy(0)
+        if home is not None and home is not copy:
+            want = _copy_dtt(home)
+    if needs_reshape(copy, want):
+        return resolve_copy(reshaped_future(copy, want))
+    return copy

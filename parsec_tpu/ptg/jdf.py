@@ -38,8 +38,12 @@ is Python floor division inside expressions; bodies use Python ``#``.
 Grammar notes (vs ``parsec.y``): execution-space ranges are ``lo .. hi`` or
 ``lo .. hi .. step``; arrow targets are ``FLOW Class(args)`` (task dep) or
 ``DataGlobal(args)`` (collection read/write-back); guards are
-``(expr) ? target`` or ``(expr) ? target : target``.  Dep ``[type=...]``
-reshape properties and ``NEW``/``NULL`` targets are not implemented yet.
+``(expr) ? target`` or ``(expr) ? target : target``.  A dep may carry
+``[type = NAME]`` reshape properties — ``NAME`` must resolve (via build
+bindings or the prologue) to a :class:`~parsec_tpu.data.datatype.TileType`,
+and the consumer of that edge observes the datum converted to it
+(read-side reshape, :mod:`parsec_tpu.data.reshape`).  ``NEW``/``NULL``
+targets are not implemented yet.
 
 Sanity checking mirrors ``jdf_sanity_checks`` (``jdf.h:68-86``): unknown
 target classes/flows/collections, missing ranges, CTL flows with data
@@ -132,14 +136,17 @@ def _split_top(s: str, sep: str) -> list[str]:
 # ---------------------------------------------------------------------------
 
 class _Arrow:
-    __slots__ = ("direction", "guard_src", "then_tgt", "else_tgt", "line")
+    __slots__ = ("direction", "guard_src", "then_tgt", "else_tgt", "line",
+                 "props")
 
-    def __init__(self, direction, guard_src, then_tgt, else_tgt, line) -> None:
+    def __init__(self, direction, guard_src, then_tgt, else_tgt, line,
+                 props=None) -> None:
         self.direction = direction      # "in" | "out"
         self.guard_src = guard_src      # str | None
         self.then_tgt = then_tgt        # (kind, name, flow, args_src)
         self.else_tgt = else_tgt        # same | None
         self.line = line
+        self.props = props or {}        # [type=NAME ...] dep properties
 
 
 class _FlowDecl:
@@ -225,10 +232,12 @@ class JDF:
                 tcb.affinity(coll, key_fn)
             if td.priority_src is not None:
                 tcb.priority(expr(td.priority_src))
+            typeenv = dict(ns)
+            typeenv.update(bindings)
             for fd in td.flows:
                 fb = tcb.flow(fd.name, fd.access)
                 for ar in fd.arrows:
-                    self._attach_arrow(fb, ar, fd, td, expr)
+                    self._attach_arrow(fb, ar, fd, td, expr, typeenv)
             for props, code_str in td.bodies:
                 btype = props.get("type", "python")
                 if btype in ("python", "cpu"):
@@ -243,9 +252,19 @@ class JDF:
 
     # -- arrows --------------------------------------------------------------
     def _attach_arrow(self, fb, ar: _Arrow, fd: _FlowDecl, td: _TaskDecl,
-                      expr) -> None:
+                      expr, typeenv: dict | None = None) -> None:
         guard = expr(ar.guard_src) if ar.guard_src else None
         neg = (lambda g, l: not guard(g, l)) if guard else None
+        dtt = None
+        tname = ar.props.get("type")
+        if tname is not None:
+            from ..data.datatype import TileType
+            dtt = (typeenv or {}).get(tname)
+            if not isinstance(dtt, TileType):
+                raise JDFError(
+                    f"line {ar.line}: [type={tname}] must name a TileType "
+                    f"global or prologue binding (got "
+                    f"{type(dtt).__name__})")
         for tgt, gfn in ((ar.then_tgt, guard),
                         (ar.else_tgt, neg if ar.else_tgt else None)):
             if tgt is None:
@@ -266,9 +285,9 @@ class JDF:
 
                 ref = (name, flow, params_fn)
                 if ar.direction == "in":
-                    fb.input(pred=ref, guard=gfn)
+                    fb.input(pred=ref, guard=gfn, dtt=dtt)
                 else:
-                    fb.output(succ=ref, guard=gfn)
+                    fb.output(succ=ref, guard=gfn, dtt=dtt)
             else:   # data
                 if fd.access == CTL:
                     raise JDFError(
@@ -276,9 +295,9 @@ class JDF:
                         f"reference data {name}()")
                 key_fn = _mk_key(expr, args_src)
                 if ar.direction == "in":
-                    fb.input(data=(name, key_fn), guard=gfn)
+                    fb.input(data=(name, key_fn), guard=gfn, dtt=dtt)
                 else:
-                    fb.output(data=(name, key_fn), guard=gfn)
+                    fb.output(data=(name, key_fn), guard=gfn, dtt=dtt)
 
     # -- sanity (jdf_sanity_checks analog) -----------------------------------
     def _sanity_check(self) -> None:
@@ -567,6 +586,24 @@ def _parse_arrows(fd: _FlowDecl, s: str, lineno: int, err) -> None:
     for direction, seg in segs:
         if not seg:
             err("empty dependency arrow")
+        # trailing [type=NAME ...] dep properties (reshape-on-dep): the
+        # first paren-top-level '[' opens them (targets only use parens)
+        props = {}
+        depth = 0
+        bpos = -1
+        for j, ch in enumerate(seg):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            elif ch == "[" and depth == 0:
+                bpos = j
+                break
+        if bpos >= 0:
+            if not seg.rstrip().endswith("]"):
+                err(f"unterminated dep properties in {seg!r}")
+            props = _parse_props(seg[bpos + 1:seg.rindex("]")])
+            seg = seg[:bpos].strip()
         guard_src = None
         then_src, else_src = seg, None
         q = _split_top(seg, "?")
@@ -585,7 +622,7 @@ def _parse_arrows(fd: _FlowDecl, s: str, lineno: int, err) -> None:
         then_tgt = _parse_target(then_src, err)
         else_tgt = _parse_target(else_src, err) if else_src else None
         fd.arrows.append(_Arrow(direction, guard_src, then_tgt, else_tgt,
-                                lineno))
+                                lineno, props))
 
 
 def _parse_target(s: str, err) -> tuple:

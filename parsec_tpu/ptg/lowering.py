@@ -157,6 +157,12 @@ def _analyze(tp) -> dict[str, _ClassInfo]:
             raise LoweringError(
                 f"task class {tc.name} has data flows but no traceable "
                 f"kernel incarnation (register_traceable under its dyld name)")
+        for f in tc.flows:
+            for d in (*f.deps_in, *f.deps_out):
+                if d.dtt is not None:
+                    raise LoweringError(
+                        f"{tc.name}.{f.name}: typed dep edges "
+                        f"([type=...]) reshape on the dynamic path")
         infos[tc.name] = _ClassInfo(tc, tasks, kernel)
     return infos
 

@@ -478,6 +478,10 @@ def _check_eligible(classes) -> None:
         if (ch.device_type != "cpu" or ch.hook is None
                 or ch.evaluate is not None or not ch.enabled):
             raise _Ineligible
+        for f in tc.flows:
+            for d in (*f.deps_in, *f.deps_out):
+                if d.dtt is not None:
+                    raise _Ineligible   # typed edges reshape dynamically
 
 
 def _build(tp, builders) -> CompiledDag | None:

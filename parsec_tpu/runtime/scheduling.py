@@ -17,6 +17,7 @@ import threading
 from typing import Any
 
 from ..core.params import params as _params
+from ..data.reshape import reshape_for_edge, reshape_for_writeback
 from ..prof import pins
 from ..prof.pins import PinsEvent
 from .task import (HOOK_RETURN_AGAIN, HOOK_RETURN_ASYNC, HOOK_RETURN_DISABLE,
@@ -166,7 +167,9 @@ def resolve_data_inputs(task: Task) -> None:
                 if copy is None:
                     raise RuntimeError(
                         f"{task}: flow {f.name} has no valid copy")
-                task.data[f.flow_index] = copy
+                # typed collection read: lazy shared repack, resolved at
+                # prepare_input (parsec_reshape.c read-side path)
+                task.data[f.flow_index] = reshape_for_edge(copy, None, d)
                 break
 
 
@@ -181,6 +184,14 @@ def prepare_input(es: ExecutionStream, task: Task) -> None:
         tc.prepare_input(es, task)
         return
     resolve_data_inputs(task)
+    # materialize pending reshape futures: the first consumer to prepare
+    # runs the conversion on its own thread (datacopy-future protocol)
+    from ..core.future import DataCopyFuture
+    from ..data.reshape import resolve_copy
+    for f in tc.flows:
+        v = task.data[f.flow_index]
+        if isinstance(v, DataCopyFuture):
+            task.data[f.flow_index] = resolve_copy(v)
     for f in tc.flows:
         if f.is_ctl or task.data[f.flow_index] is not None:
             continue
@@ -270,14 +281,19 @@ def release_deps(es: ExecutionStream, task: Task) -> None:
             fi, di = _find_input_dep(succ_tc, dep.target_flow, tc.name,
                                      succ_locals)
             repo_ref = None
+            send = out_copy
             if out_copy is not None:
                 if entry is None:
                     entry = tc.repo.lookup_and_create(t.key)
                 entry.set_output(flow.flow_index, out_copy)
                 repo_ref = (entry, flow.flow_index)
                 nconsumers += 1
+                # typed edge: the consumer receives a lazy shared repack,
+                # not the producer's copy (read-side reshape)
+                send = reshape_for_edge(out_copy, dep,
+                                        succ_tc.flows[fi].deps_in[di])
             ready_task = ctx.deps.release_dep(tp, succ_tc, succ_locals, fi,
-                                              di, out_copy, repo_ref)
+                                              di, send, repo_ref)
             if ready_task is not None:
                 ready.append(ready_task)
 
@@ -295,6 +311,7 @@ def _writeback(task: Task, flow, dep, out_copy) -> None:
     if out_copy is None or dep.data_ref is None:
         return
     dc, key = dep.data_ref(task.locals)
+    out_copy = reshape_for_writeback(out_copy, dep, dc, key)
     apply_writeback_to_home(dc, key, out_copy)
 
 
