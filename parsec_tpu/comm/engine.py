@@ -127,6 +127,10 @@ class CommEngine:
         self._mem: dict[int, MemHandle] = {}
         self._mem_lock = threading.Lock()
         self._enabled = False
+        # upper-layer flush callback (the remote-dep outgoing stage): every
+        # progress() drives it, so loops that spin on raw engine progress
+        # (sync, quiesce) can never strand staged sends
+        self.flush_hook: Callable[[], int] | None = None
 
     # -- active messages ----------------------------------------------------
     def tag_register(self, tag: int, cb: Callable[[Any, int, Any], None]) -> None:
@@ -262,6 +266,8 @@ class InprocCommEngine(CommEngine):
             return 0
         try:
             n = 0
+            if self.flush_hook is not None:
+                n += self.flush_hook()
             for tag, src, payload in self.fabric.drain(self.rank):
                 cb = self._am_callbacks.get(tag)
                 if cb is None:

@@ -51,6 +51,13 @@ from .engine import (AM_TAG_ACTIVATE, AM_TAG_DTD, AM_TAG_GET_ACK,
 _params.register("comm_short_limit", 4096,
                  "payloads at most this many bytes ride inside the "
                  "activation message (short-message inlining)")
+_params.register("comm_thread", False,
+                 "run a dedicated comm-progress thread per rank "
+                 "(remote_dep_dequeue_main analog)")
+_params.register("comm_coalesce", True,
+                 "stage outgoing activations and flush one "
+                 "priority-ordered AM per peer per progress "
+                 "(remote_dep_mpi.c:1066-1194 aggregation)")
 _params.register("comm_bcast_tree", "binomial",
                  "multi-peer activation propagation: binomial|chain|star")
 
@@ -140,6 +147,15 @@ class RemoteDepEngine:
         self.nranks = ce.nranks
         self._es = ExecutionStream(-2, context.virtual_processes[0], context)
         self._seq = itertools.count(1)
+        # outgoing activation stage: per-peer pending lists flushed by
+        # progress (or the dedicated comm thread) as ONE coalesced AM per
+        # peer, priority-ordered — the dep_cmd_queue aggregation of
+        # remote_dep_mpi.c:1066-1194
+        self._outq: dict[int, list] = {}
+        self._outq_lock = threading.Lock()
+        self._outseq = itertools.count()
+        self._comm_thread: threading.Thread | None = None
+        self._comm_stop: threading.Event | None = None
         # activation seq -> (taskpool, parent_rank or None)
         self._inflight: dict[int, Any] = {}
         self._iflock = threading.Lock()
@@ -156,16 +172,87 @@ class RemoteDepEngine:
         ce.tag_register(AM_TAG_GET_ACK, self._on_ack)
         ce.tag_register(AM_TAG_TERMDET, self._on_termdet)
         ce.tag_register(AM_TAG_DTD, self._on_dtd)
+        # every engine progress drives the outgoing stage too — loops that
+        # spin on raw ce.progress() (sync, quiesce) must flush forwards
+        # their own AM handlers stage mid-wait
+        ce.flush_hook = self.flush_outgoing
 
     # ------------------------------------------------------------ lifecycle
     def enable(self) -> None:
         self.ce.enable()
+        if _params.get("comm_thread") and self._comm_thread is None:
+            # the dedicated progress thread of remote_dep_mpi.c's
+            # remote_dep_dequeue_main: owns flushing + draining so workers
+            # never stall on comm (they may still opportunistically
+            # progress; the engine's internal lock keeps it single-driver)
+            self._comm_stop = threading.Event()
+            self._comm_thread = threading.Thread(
+                target=self._comm_main, daemon=True,
+                name=f"parsec-comm-r{self.my_rank}")
+            self._comm_thread.start()
+
+    def _comm_main(self) -> None:
+        from ..core.backoff import Backoff
+        backoff = Backoff()
+        while not self._comm_stop.is_set():
+            try:
+                n = self.flush_outgoing() + self.ce.progress()
+            except BaseException as e:   # surface like a worker failure:
+                with self.ctx._lock:     # a silent dead comm thread is a
+                    if self.ctx._worker_error is None:   # hang, not a crash
+                        self.ctx._worker_error = e
+                    self.ctx._cond.notify_all()
+                return
+            if n:
+                backoff.reset()
+            else:
+                backoff.wait()
 
     def fini(self) -> None:
+        if self._comm_thread is not None:
+            self._comm_stop.set()
+            self._comm_thread.join(timeout=5)
+            self._comm_thread = None
+        self.flush_outgoing()
         self.ce.fini()
 
     def progress(self, es: Any = None) -> int:
-        return self.ce.progress()
+        return self.flush_outgoing() + self.ce.progress()
+
+    # -------------------------------------------- outgoing stage (coalescing)
+    def _post_activate(self, dst: int, msg: dict) -> None:
+        if not _params.get("comm_coalesce"):
+            self.ce.send_am(AM_TAG_ACTIVATE, dst, msg)
+            return
+        with self._outq_lock:
+            self._outq.setdefault(dst, []).append(
+                (-msg.get("priority", 0), next(self._outseq), msg))
+
+    def _flush_if_unthreaded(self) -> None:
+        """The staging queue is the comm thread's mailbox; without one,
+        flush at the end of each send batch so busy workers never starve
+        outgoing sends (coalescing still aggregates within the batch)."""
+        if self._comm_thread is None:
+            self.flush_outgoing()
+
+    def flush_outgoing(self) -> int:
+        """Drain the outgoing stage: one AM per peer, messages inside
+        ordered highest-priority-first (the same-peer aggregation +
+        priority ordering of remote_dep_mpi.c:1066-1194)."""
+        if not self._outq:
+            return 0
+        with self._outq_lock:
+            batches, self._outq = self._outq, {}
+        n = 0
+        for dst, items in batches.items():
+            items.sort(key=lambda it: it[:2])
+            msgs = [m for _, _, m in items]
+            if len(msgs) == 1:
+                self.ce.send_am(AM_TAG_ACTIVATE, dst, msgs[0])
+            else:
+                self.ce.send_am(AM_TAG_ACTIVATE, dst, {"batch": msgs})
+            n += len(msgs)
+        return n
 
     def inflight(self) -> int:
         with self._iflock:
@@ -178,8 +265,8 @@ class RemoteDepEngine:
         import time
         deadline = time.monotonic() + timeout
         for _round in range(2):
-            while self.inflight() or self.ce.pending():
-                self.ce.progress()
+            while self.inflight() or self.ce.pending() or self._outq:
+                self.progress()
                 if time.monotonic() > deadline:
                     raise TimeoutError(f"rank {self.my_rank} quiesce timeout")
             self.ce.sync()
@@ -258,6 +345,7 @@ class RemoteDepEngine:
                 "priority": task.priority,
             }
             self._send_to_children(tp, msg, my_pos=0)
+        self._flush_if_unthreaded()
 
     def _send_to_children(self, tp: Any, msg: dict, my_pos: int) -> None:
         ranks = msg["ranks"]
@@ -272,7 +360,7 @@ class RemoteDepEngine:
             child_msg = dict(msg)
             child_msg["seq"] = seq
             child_msg["pos"] = child_pos
-            self.ce.send_am(AM_TAG_ACTIVATE, ranks[child_pos], child_msg)
+            self._post_activate(ranks[child_pos], child_msg)
 
     def _on_ack(self, eng, src: int, msg: dict) -> None:
         with self._iflock:
@@ -357,6 +445,11 @@ class RemoteDepEngine:
         self.ce.send_am(AM_TAG_GET_ACK, src, {"seq": msg["seq"]})
 
     def _on_activate(self, eng, src: int, msg: dict) -> None:
+        if "batch" in msg:
+            # a coalesced same-peer aggregate: unpack in (priority) order
+            for m in msg["batch"]:
+                self._on_activate(eng, src, m)
+            return
         tp = self._lookup_or_pend(self._on_activate, src, msg)
         if tp is None:
             return
@@ -460,6 +553,7 @@ class RemoteDepEngine:
                     h = self.ce.mem_register(value, refcount=len(children))
                     d["wire"] = h.wire()
             self._send_to_children(tp, fwd, my_pos=my_pos)
+            self._flush_if_unthreaded()
 
         self.ce.send_am(AM_TAG_GET_ACK, src, {"seq": msg["seq"]})
         if ready:
