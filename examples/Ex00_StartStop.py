@@ -1,0 +1,20 @@
+"""Ex00: runtime lifecycle — init a context, start it, wait, shut down.
+
+The smallest possible program (reference ``examples/Ex00_StartStop.c``):
+no taskpool at all, just the `parsec_init` / `parsec_context_start` /
+`parsec_context_wait` / `parsec_fini` sequence.
+"""
+
+from parsec_tpu.runtime import Context
+
+
+def main() -> str:
+    ctx = Context(nb_cores=0)
+    ctx.start()
+    ctx.wait()      # nothing enqueued: returns immediately
+    ctx.fini()
+    return "context lifecycle ok"
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,55 @@
+"""Ex03: the chain distributed — task affinity walks the ranks.
+
+Reference ``examples/Ex03_ChainMPI.jdf``: the Ex02 chain where task ``T(i)``
+lives on rank ``i % nranks`` (the data collection's ``rank_of``), so the
+tile hops rank to rank through the remote-dep protocol.  Runs 4 inproc
+ranks over the comm engine — the oversubscribed-MPI analog; pass
+``transport="device"`` for the device-backed fabric.
+"""
+
+import numpy as np
+
+from parsec_tpu import ptg
+from parsec_tpu.comm import run_multirank
+from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
+
+NB = 8
+NRANKS = 4
+
+
+def body_fn(ctx, rank, nranks):
+    V = VectorTwoDimCyclic("V", lm=NB, mb=1, P=nranks, myrank=rank,
+                           init_fn=lambda m, size: np.zeros(size, np.float32))
+    p = ptg.PTGBuilder("chainmpi", V=V, NB=NB)
+    t = p.task("T", i=ptg.span(0, lambda g, l: g.NB - 1))
+    t.affinity("V", lambda g, l: (l.i,))      # T(i) runs on rank_of(V(i))
+    f = t.flow("A", ptg.RW)
+    f.input(data=("V", lambda g, l: (0,)), guard=lambda g, l: l.i == 0)
+    f.input(pred=("T", "A", lambda g, l: {"i": l.i - 1}),
+            guard=lambda g, l: l.i > 0)
+    f.output(succ=("T", "A", lambda g, l: {"i": l.i + 1}),
+             guard=lambda g, l: l.i < g.NB - 1)
+    f.output(data=("V", lambda g, l: (0,)),
+             guard=lambda g, l: l.i == g.NB - 1)
+
+    @t.body
+    def body(es, task, g, l):
+        v = task.flow_data("A")
+        v.value = np.asarray(v.value) + 1
+
+    ctx.add_taskpool(p.build())
+    ctx.wait(timeout=60)
+    ctx.comm_barrier()
+    if rank == 0:     # V(0) is homed on rank 0
+        return float(np.asarray(V.data_of(0).newest_copy().value)[0])
+    return None
+
+
+def main() -> float:
+    res = run_multirank(NRANKS, body_fn)
+    assert res[0] == NB, res
+    return res[0]
+
+
+if __name__ == "__main__":
+    print(f"chain hopped {NRANKS} ranks, counted to {main():.0f}")

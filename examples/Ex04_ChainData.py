@@ -1,0 +1,48 @@
+"""Ex04: the chain reading/writing the data collection — textual JDF.
+
+Reference ``examples/Ex04_ChainData.jdf``: each task reads its own tile
+``A(i)`` from the collection, adds the running value, and writes it back —
+direct memory access colocated with task placement.  This is the exit test
+of SURVEY §7 step 3: a reference-shaped ``.jdf`` ingested by the textual
+front-end.
+"""
+
+import numpy as np
+
+from parsec_tpu.data.data import TileType
+from parsec_tpu.data_dist.collection import DictCollection
+from parsec_tpu.ptg.jdf import parse_jdf
+from parsec_tpu.runtime import Context
+
+NB = 6
+
+JDF = """
+A     [type = data]
+NB    [type = int]
+
+Task(i)
+  i = 0 .. NB - 1
+  : A(i)
+  RW  V <- (i == 0) ? A(0) : V Task(i - 1)
+        -> (i < NB - 1) ? V Task(i + 1) : A(0)
+BODY
+  V[...] = V + i
+END
+"""
+
+
+def main() -> float:
+    coll = DictCollection("A", dtt=TileType((1,), np.float32),
+                          init_fn=lambda *k: np.zeros(1, np.float32))
+    tp = parse_jdf(JDF, "chaindata").build(A=coll, NB=NB)
+    ctx = Context(nb_cores=0)
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=30)
+    ctx.fini()
+    out = float(coll.data_of(0).newest_copy().value[0])
+    assert out == sum(range(NB)), out
+    return out
+
+
+if __name__ == "__main__":
+    print(f"chain-data summed 0..{NB - 1} = {main():.0f}")

@@ -212,6 +212,52 @@ class Profiling:
         import pandas as pd
         return pd.DataFrame(self.to_records())
 
+    def to_chrome_trace(self, path: str | None = None) -> dict:
+        """Export as Chrome trace-event JSON (the standard-viewer export —
+        the role ``profiling_otf2.c`` plays in the reference; Perfetto /
+        chrome://tracing consume this directly).
+
+        Complete events (``ph: X``) carry begin/duration in microseconds;
+        one process, one tid per profiling stream, stream names attached
+        as thread-name metadata.  Returns the trace dict; writes JSON to
+        ``path`` when given.
+        """
+        events: list[dict] = []
+        seen_streams: dict[int, str] = {}
+        for rec in self.to_records():
+            tid = rec["stream_id"]
+            seen_streams.setdefault(tid, rec["stream"])
+            ec = self.dictionary.get(rec["name"])
+            ev = {
+                "name": rec["name"],
+                "cat": "parsec",
+                "ph": "X",
+                "ts": rec["begin_ns"] / 1e3,
+                "dur": rec["duration_ns"] / 1e3,
+                "pid": 0,
+                "tid": tid,
+                "args": {k.removeprefix("info."): v
+                         for k, v in rec.items()
+                         if k.startswith("info.")} | {
+                             "object_id": rec["object_id"],
+                             "event_id": rec["event_id"]},
+            }
+            # the dictionary's display color rides in args: trace-event
+            # 'cname' only accepts the viewer's reserved color names, and
+            # legacy chrome://tracing rejects traces with unknown ones
+            if ec is not None and ec.color:
+                ev["args"]["color"] = ec.color
+            events.append(ev)
+        meta = [{"name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+                 "args": {"name": name}}
+                for tid, name in sorted(seen_streams.items())]
+        trace = {"traceEvents": meta + events,
+                 "displayTimeUnit": "ms"}
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(trace, f)
+        return trace
+
     def validate(self) -> list[str]:
         """Well-formedness checks (the check-async.py analog): every begin
         has a matching end on the same stream, timestamps are ordered."""

@@ -11,7 +11,7 @@ from parsec_tpu.comm.remote_dep import RemoteDepEngine
 from parsec_tpu.core.params import params
 from parsec_tpu.data_dist.matrix import TwoDimBlockCyclic
 from parsec_tpu.models.tiled_gemm import tiled_gemm_ptg
-from parsec_tpu.runtime import Context
+from parsec_tpu.runtime import Context  # noqa: F401 (e2e bodies)
 
 
 @pytest.fixture
@@ -42,22 +42,22 @@ class _SpyEngine:
 
 
 def mk_engine(spy):
-    ctx = Context(nb_cores=0)
-    eng = RemoteDepEngine.__new__(RemoteDepEngine)
+    """A bare outgoing stage: the coalescing tests need no live Context."""
     import itertools
     import threading
+    eng = RemoteDepEngine.__new__(RemoteDepEngine)
     eng.ce = spy
     eng._outq = {}
     eng._outq_lock = threading.Lock()
     eng._outseq = itertools.count()
-    return ctx, eng
+    return eng
 
 
 class TestCoalescing:
     def test_same_peer_batches_priority_ordered(self, param):
         param("comm_coalesce", True)
         spy = _SpyEngine()
-        ctx, eng = mk_engine(spy)
+        eng = mk_engine(spy)
         eng._post_activate(1, {"priority": 1, "id": "low"})
         eng._post_activate(1, {"priority": 9, "id": "high"})
         eng._post_activate(1, {"priority": 5, "id": "mid"})
@@ -70,25 +70,22 @@ class TestCoalescing:
         assert by_dst[2]["id"] == "other-peer"   # singletons ride unbatched
         assert all(tag == AM_TAG_ACTIVATE for tag, _, _ in spy.sent)
         assert eng.flush_outgoing() == 0
-        ctx.fini()
 
     def test_fifo_within_equal_priority(self, param):
         param("comm_coalesce", True)
         spy = _SpyEngine()
-        ctx, eng = mk_engine(spy)
+        eng = mk_engine(spy)
         for i in range(3):
             eng._post_activate(1, {"priority": 7, "id": i})
         eng.flush_outgoing()
         assert [m["id"] for m in spy.sent[0][2]["batch"]] == [0, 1, 2]
-        ctx.fini()
 
     def test_disabled_sends_immediately(self, param):
         param("comm_coalesce", False)
         spy = _SpyEngine()
-        ctx, eng = mk_engine(spy)
+        eng = mk_engine(spy)
         eng._post_activate(1, {"priority": 1})
         assert len(spy.sent) == 1
-        ctx.fini()
 
 
 def _gemm_body(ctx, rank, nranks):

@@ -1,0 +1,28 @@
+"""The Ex00–Ex07 examples ladder is living documentation: every script
+must keep running and self-checking (reference examples/ + SURVEY §2.11)."""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+EXAMPLES = sorted(
+    (pathlib.Path(__file__).parent.parent / "examples").glob("Ex*.py"))
+
+
+def load(path):
+    spec = importlib.util.spec_from_file_location(path.stem, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_ladder_is_complete():
+    assert [p.stem.split("_")[0] for p in EXAMPLES] == \
+        [f"Ex{i:02d}" for i in range(8)]
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: p.stem)
+def test_example_runs(path):
+    mod = load(path)
+    mod.main()   # every example self-checks and raises on failure

@@ -132,3 +132,25 @@ def test_properties_dictionary(tmp_path):
         assert data["props"]["test"]["x"] == 7
     finally:
         properties.unregister("test", "x")
+
+
+def test_chrome_trace_export(tmp_path, traced):
+    """The standard-viewer export (profiling_otf2.c role): trace-event
+    JSON consumable by Perfetto / chrome://tracing."""
+    import json
+
+    _run_small_gemm()
+    path = str(tmp_path / "trace.json")
+    trace = traced.to_chrome_trace(path)
+    on_disk = json.load(open(path))
+    assert on_disk == json.loads(json.dumps(trace))
+    evs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert len(evs) == len(traced.to_records())
+    execs = [e for e in evs if e["name"] == "task_exec"]
+    assert len(execs) == 8
+    for e in execs:
+        assert e["dur"] > 0
+        assert e["args"]["task"] == "GEMM"
+    metas = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert len(metas) >= 1
+    assert {m["tid"] for m in metas} >= {e["tid"] for e in evs}
