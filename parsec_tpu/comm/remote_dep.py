@@ -515,7 +515,8 @@ class RemoteDepEngine:
                     dc, key = dep.data_ref(t.locals)
                     if copy is not None and dc.rank_of(*key) == self.my_rank:
                         copy = reshape_for_writeback(copy, dep, dc, key)
-                        apply_writeback_to_home(dc, key, copy)
+                        apply_writeback_to_home(dc, key, copy,
+                                                owner=tp.taskpool_id)
                 return
             succ_tc = tp.task_class(dep.target_class)
             for succ_locals in dep.each_target(t.locals):

@@ -17,6 +17,7 @@ from .mempool import Mempool, ThreadMempool
 from .output import (FatalError, debug_verbose, fatal, inform, output_open,
                      warning)
 from .params import ParamRegistry, params, register
+from .rwlock import RWLock
 
 __all__ = [
     "Backoff", "Component", "ComponentRepository", "ConcurrentHashTable",

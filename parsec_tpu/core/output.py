@@ -101,3 +101,40 @@ def warning(msg: str, *args) -> None:
 def fatal(msg: str, *args) -> None:
     debug_stream._log.error("FATAL: " + msg, *args)
     raise FatalError(msg % args if args else msg)
+
+
+# ---------------------------------------------------------------------------
+# show_help: deduplicated long-form diagnostics (cf. utils/show_help.c —
+# the opal-inherited "print a help topic once, aggregate repeats" protocol)
+# ---------------------------------------------------------------------------
+
+_help_lock = threading.Lock()
+_help_seen: dict[tuple[str, str], int] = {}
+
+
+def show_help(topic: str, section: str, msg: str, *args) -> bool:
+    """Emit a long-form diagnostic once per (topic, section); later calls
+    only count.  Returns True when the message was actually printed.
+    :func:`show_help_flush` reports the aggregate counts (the reference
+    prints "N more instances of this help topic" at finalize)."""
+    key = (topic, section)
+    with _help_lock:
+        n = _help_seen.get(key, 0)
+        _help_seen[key] = n + 1
+        if n:
+            return False
+    debug_stream.inform(f"[help: {topic}:{section}] {msg}", *args)
+    return True
+
+
+def show_help_flush() -> dict[tuple[str, str], int]:
+    """Report and reset the suppressed-repeat counts."""
+    with _help_lock:
+        counts = dict(_help_seen)
+        _help_seen.clear()
+    for (topic, section), n in counts.items():
+        if n > 1:
+            debug_stream.inform(
+                f"[help: {topic}:{section}] shown once; "
+                f"{n - 1} repeat(s) suppressed")
+    return counts

@@ -39,7 +39,8 @@ class DataCopy:
     """One device's copy of a datum (cf. ``parsec_data_copy_t``)."""
 
     __slots__ = ("original", "device_index", "coherency", "readers", "version",
-                 "value", "dtt", "flags", "arena_chunk", "reshaped")
+                 "value", "dtt", "flags", "arena_chunk", "reshaped",
+                 "wb_mark")
 
     def __init__(self, original: "Data", device_index: int,
                  value: Any = None, dtt: TileType | None = None) -> None:

@@ -9,9 +9,10 @@ chains (RAW/WAR/WAW), with a sliding insertion window for backpressure.
 from .insert import (AFFINITY, DONT_TRACK, INOUT, INPUT, OUTPUT, PULLIN,
                      PUSHOUT, REF, SCRATCH, VALUE, DTDTaskpool, DTDTile,
                      Scratch, unpack_args)
+from .from_ptg import ptg_to_dtd
 
 __all__ = [
     "DTDTaskpool", "DTDTile", "Scratch", "unpack_args",
     "INPUT", "OUTPUT", "INOUT", "VALUE", "SCRATCH", "REF",
-    "AFFINITY", "DONT_TRACK", "PUSHOUT", "PULLIN",
+    "AFFINITY", "DONT_TRACK", "PUSHOUT", "PULLIN", "ptg_to_dtd",
 ]

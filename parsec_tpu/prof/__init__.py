@@ -16,6 +16,8 @@ from .profiling import profiling as trace_state   # the global instance —
 from .counters import properties, sde
 from . import task_profiler as _task_profiler   # register components
 from . import grapher as _grapher               # register components
+from . import debug_marks as _debug_marks       # register components
+from . import iterators_checker as _iterchk     # register components
 
 __all__ = ["PinsEvent", "pins", "Profiling", "trace_state", "properties",
            "sde"]

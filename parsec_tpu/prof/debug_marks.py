@@ -1,0 +1,96 @@
+"""debug_marks: a post-mortem ring of recent runtime events.
+
+Rebuild of ``parsec/debug_marks.c`` (SURVEY §2.3): a fixed-size circular
+buffer of cheap event marks (select/exec/complete/release with task
+identity and thread id) kept purely in memory — when a run wedges or
+crashes, :func:`dump` reconstructs the last N things every stream did.
+Installed as a PINS module so the marks ride the same callback chain the
+profiler uses; the ring costs one deque append per event.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from ..core.mca import Component, component
+from ..core.params import params as _params
+from . import pins
+from .pins import PinsEvent
+
+_params.register("debug_marks_size", 512,
+                 "circular-buffer capacity of the debug-marks ring")
+
+
+class MarkRing:
+    def __init__(self, capacity: int) -> None:
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def mark(self, kind: str, what: str) -> None:
+        with self._lock:
+            self._ring.append((time.monotonic_ns(),
+                               threading.get_ident() & 0xFFFF, kind, what))
+
+    def snapshot(self) -> list[tuple]:
+        with self._lock:
+            return list(self._ring)
+
+    def dump(self) -> str:
+        lines = [f"{ts} t{tid:04x} {kind:<14} {what}"
+                 for ts, tid, kind, what in self.snapshot()]
+        return "\n".join(lines)
+
+
+ring = MarkRing(512)    # re-sized from the param at each module install
+
+
+class DebugMarksModule:
+    EVENTS = {
+        PinsEvent.SELECT_END: "select",
+        PinsEvent.EXEC_BEGIN: "exec_begin",
+        PinsEvent.EXEC_END: "exec_end",
+        PinsEvent.COMPLETE_EXEC_END: "complete",
+        PinsEvent.RELEASE_DEPS_BEGIN: "release_deps",
+    }
+
+    def __init__(self) -> None:
+        self._cbs: list[tuple[PinsEvent, Any]] = []
+
+    def install(self) -> None:
+        global ring
+        ring = MarkRing(_params.get("debug_marks_size"))
+        for ev, kind in self.EVENTS.items():
+            def mk(kind):
+                def cb(es, payload):
+                    # None payloads (e.g. empty select polls) would flood
+                    # the ring and evict the post-mortem evidence
+                    if payload is None:
+                        return
+                    ring.mark(kind, repr(payload))
+                return cb
+            cb = mk(kind)
+            pins.register(ev, cb)
+            self._cbs.append((ev, cb))
+
+    def uninstall(self) -> None:
+        for ev, cb in self._cbs:
+            pins.unregister(ev, cb)
+        self._cbs.clear()
+
+
+@component
+class DebugMarksComponent(Component):
+    type_name = "pins"
+    name = "debug_marks"
+    priority = 0
+
+    def open(self, context: Any = None) -> DebugMarksModule:
+        mod = DebugMarksModule()
+        mod.install()
+        return mod
+
+    def close(self, module: DebugMarksModule) -> None:
+        module.uninstall()

@@ -66,16 +66,19 @@ class Context:
         # devices: registry is process-global; the context snapshots it
         self.devices = device_registry
 
-        # virtual processes + streams (vpmap flat mode: one VP by default)
-        nb_vp = max(1, _params.get("runtime_nb_vp"))
+        # virtual processes + streams, per the vpmap spec (vpmap.py)
+        from .vpmap import nb_vps, parse_vpmap
         nworkers = max(nb_cores, 0)
+        nstreams = max(nworkers, 1)
+        assignment = parse_vpmap(_params.get("runtime_vpmap"), nstreams,
+                                 _params.get("runtime_nb_vp"))
         self.virtual_processes: list[VirtualProcess] = []
         streams: list[ExecutionStream] = []
-        for v in range(nb_vp):
+        for v in range(nb_vps(assignment)):
             vp = VirtualProcess(v, self)
             self.virtual_processes.append(vp)
-        for i in range(max(nworkers, 1)):
-            vp = self.virtual_processes[i % nb_vp]
+        for i in range(nstreams):
+            vp = self.virtual_processes[assignment[i]]
             es = ExecutionStream(i if nworkers else -1, vp, self)
             vp.execution_streams.append(es)
             streams.append(es)

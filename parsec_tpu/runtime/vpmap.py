@@ -1,0 +1,53 @@
+"""Virtual-process maps (cf. ``parsec/vpmap.c``).
+
+The reference builds stream→VP assignments from MCA specs: flat (one VP),
+round-robin over N VPs, or an explicit per-VP description from a file.
+hwloc-derived maps don't apply under the GIL; the spec grammar survives:
+
+- ``""``        — legacy default: round-robin over ``runtime_nb_vp`` VPs;
+- ``flat``      — one VP holding every stream (``vpmap_init_from_flat``);
+- ``rr:N``      — N VPs, streams dealt round-robin (``_from_parameters``);
+- ``list:a,b,c``— explicit VP sizes (``_from_file`` one-liner form);
+- ``file:PATH`` — one VP size per line in PATH.
+"""
+
+from __future__ import annotations
+
+from ..core.params import params as _params
+
+_params.register("runtime_vpmap", "",
+                 "virtual-process map spec: flat | rr:N | list:a,b,c | "
+                 "file:PATH (empty = round-robin over runtime_nb_vp)")
+
+
+def parse_vpmap(spec: str, nstreams: int, nb_vp: int) -> list[int]:
+    """Per-stream VP index for ``nstreams`` streams."""
+    spec = (spec or "").strip()
+    if not spec:
+        nvp = max(1, nb_vp)
+        return [i % nvp for i in range(nstreams)]
+    if spec == "flat":
+        return [0] * nstreams
+    if spec.startswith("rr:"):
+        nvp = max(1, int(spec[3:]))
+        return [i % nvp for i in range(nstreams)]
+    if spec.startswith("list:"):
+        sizes = [int(s) for s in spec[5:].split(",") if s.strip()]
+    elif spec.startswith("file:"):
+        with open(spec[5:]) as f:
+            sizes = [int(s) for s in (line.strip() for line in f)
+                     if s and not s.startswith("#")]
+    else:
+        raise ValueError(f"bad runtime_vpmap spec {spec!r}")
+    if not sizes or any(s <= 0 for s in sizes):
+        raise ValueError(f"runtime_vpmap sizes must be positive: {sizes}")
+    out: list[int] = []
+    for v, size in enumerate(sizes):
+        out.extend([v] * size)
+    if len(out) < nstreams:       # spill extras round-robin (ref: clamps)
+        out.extend(i % len(sizes) for i in range(nstreams - len(out)))
+    return out[:nstreams]
+
+
+def nb_vps(assignment: list[int]) -> int:
+    return (max(assignment) + 1) if assignment else 1

@@ -1,12 +1,16 @@
 """Scheduler implementations.
 
 Rebuild of the reference's scheduler zoo (``parsec/mca/sched/*``, SURVEY
-§2.4): **lfq** (default) per-stream bounded buffers spilling to a per-VP
-overflow dequeue, with sibling stealing; **ap** global absolute-priority
-list; **spq** global priority+distance list (the tutorial scheduler,
-``sched.h:87-169``); **gd** global dequeue; **ll/llp** per-stream LIFOs with
-stealing (± priority); **rnd** random; **ip** inverse priority.  Priorities
-and the distance contract follow ``sched/api.py``.
+§2.4), all eleven: **lfq** (default) per-stream bounded buffers spilling to
+a per-VP overflow dequeue, with sibling stealing; **ap** global
+absolute-priority list; **spq** global priority+distance list (the tutorial
+scheduler, ``sched.h:87-169``); **gd** global dequeue; **ll/llp** per-stream
+LIFOs with stealing (± priority); **rnd** random; **ip** inverse priority;
+and the local-hierarchical family — **pbq** priority-based local queues with
+proximity-ordered stealing, **ltq** local tree queues whose steals migrate
+whole release-subtrees, **lhq** local hierarchical queues with an
+intermediate group rung.  Priorities and the distance contract follow
+``sched/api.py``.
 """
 
 from __future__ import annotations
@@ -313,6 +317,219 @@ class LLPModule(LLModule):
 
 
 # ---------------------------------------------------------------------------
+# the local-hierarchical family: pbq / ltq / lhq
+# (cf. sched_local_queues_utils.h: per-stream hbbuffer "task_queue", an
+#  ordered list of hierarch queues to steal from, and a shared system
+#  dequeue.  hwloc proximity becomes th_id ring distance here — the GIL
+#  flattens cache hierarchy, the *structure* is what is rebuilt.)
+# ---------------------------------------------------------------------------
+
+class PBQModule(SchedulerModule):
+    """Priority-based local queues (``mca/sched/pbq``): per-stream bounded
+    buffer with best-priority pop, nearest-neighbor steal order, shared
+    system dequeue."""
+
+    name = "pbq"
+
+    def install(self, context: Any) -> None:
+        self._order: dict[int, list] = {}   # id(es) -> cached steal order
+        for vp in context.virtual_processes:
+            vp.sched_private = _VPQueues()
+            # reference queue_size = 4 * vp->nb_cores — per VP
+            vp.sched_private.cap = max(4, 4 * len(vp.execution_streams))
+
+    def flow_init(self, es: Any) -> None:
+        vpq = es.virtual_process.sched_private
+
+        def overflow(items: list, distance: int) -> None:
+            with vpq.lock:
+                vpq.system.extend(items)
+
+        es.sched_private = HBBuffer(vpq.cap, parent_push=overflow)
+
+    def _steal_order(self, es: Any) -> list:
+        order = self._order.get(id(es))
+        if order is None:
+            sibs = es.virtual_process.execution_streams
+            n = len(sibs)
+            me = sibs.index(es)
+            idx = {id(s): i for i, s in enumerate(sibs)}
+            # ring distance: the hwloc-proximity stand-in; static per
+            # stream, so computed once and cached
+            order = sorted((s for s in sibs if s is not es),
+                           key=lambda s: min((idx[id(s)] - me) % n,
+                                             (me - idx[id(s)]) % n))
+            self._order[id(es)] = order
+        return order
+
+    def schedule(self, es: Any, tasks: Sequence[Any],
+                 distance: int = 0) -> None:
+        if es.sched_private is None or distance > 0:
+            vpq = es.virtual_process.sched_private
+            with vpq.lock:
+                vpq.system.extend(tasks)
+            return
+        es.sched_private.push_all(list(tasks), distance)
+
+    def select(self, es: Any) -> tuple[Any | None, int]:
+        if es.sched_private is not None:
+            t = es.sched_private.try_pop_best(
+                priority=lambda x: x.priority)
+            if t is not None:
+                return t, 0
+            for d, sib in enumerate(self._steal_order(es)):
+                if sib.sched_private is None:
+                    continue
+                t = sib.sched_private.steal()
+                if t is not None:
+                    return t, 1 + d
+        vpq = es.virtual_process.sched_private
+        with vpq.lock:
+            if vpq.system:
+                return vpq.system.popleft(), 99
+        return None, 0
+
+    def remove(self, context: Any) -> None:
+        for vp in context.virtual_processes:
+            vp.sched_private = None
+            for es in vp.execution_streams:
+                es.sched_private = None
+
+    def pending_tasks(self, context: Any) -> int:
+        n = 0
+        for vp in context.virtual_processes:
+            if vp.sched_private is not None:
+                n += len(vp.sched_private.system)
+            for es in vp.execution_streams:
+                if es.sched_private is not None:
+                    n += len(es.sched_private)
+        return n
+
+
+class _Bundle:
+    """A released batch kept together — the maxheap node of ltq: the owner
+    pops the best task off the top; a thief migrates the whole remainder
+    (subtree stealing)."""
+
+    __slots__ = ("tasks",)
+
+    def __init__(self, tasks: list) -> None:
+        self.tasks = sorted(tasks, key=lambda t: t.priority, reverse=True)
+
+    @property
+    def priority(self) -> int:
+        return self.tasks[0].priority if self.tasks else -1
+
+
+class LTQModule(PBQModule):
+    """Local tree queues (``mca/sched/ltq``): releases travel as heaps —
+    one steal migrates a whole subtree of related work, preserving the
+    producer-consumer locality the tree encodes."""
+
+    name = "ltq"
+
+    def schedule(self, es: Any, tasks: Sequence[Any],
+                 distance: int = 0) -> None:
+        if not tasks:
+            return
+        super().schedule(es, [_Bundle(list(tasks))], distance)
+
+    def select(self, es: Any) -> tuple[Any | None, int]:
+        b, d = super().select(es)
+        if b is None:
+            return None, 0
+        t = b.tasks.pop(0)
+        if b.tasks and es.sched_private is not None:
+            # remainder stays with whoever popped it (subtree migration)
+            es.sched_private.push_all([b], 0)
+        return t, d
+
+    def pending_tasks(self, context: Any) -> int:
+        n = 0
+        for vp in context.virtual_processes:
+            if vp.sched_private is not None:
+                n += sum(len(b.tasks) for b in vp.sched_private.system)
+            for es in vp.execution_streams:
+                if es.sched_private is not None:
+                    n += sum(len(b.tasks) for b in es.sched_private._items)
+        return n
+
+
+class LHQModule(PBQModule):
+    """Local hierarchical queues (``mca/sched/lhq``): an intermediate
+    *group* buffer between the per-stream buffers and the system queue —
+    the hwloc-level ladder with two rungs (stream → group → VP)."""
+
+    name = "lhq"
+
+    def install(self, context: Any) -> None:
+        super().install(context)
+        self._group: dict[int, Any] = {}   # id(es) -> its group buffer
+        for vp in context.virtual_processes:
+            # two groups per VP (the socket split stand-in)
+            ngroups = 2 if len(vp.execution_streams) > 1 else 1
+            vpq = vp.sched_private
+            vpq.groups = []
+            for _g in range(ngroups):
+                def spill(items: list, distance: int, vpq=vpq) -> None:
+                    with vpq.lock:
+                        vpq.system.extend(items)
+                vpq.groups.append(HBBuffer(vpq.cap, parent_push=spill))
+
+    def _group_of(self, es: Any):
+        grp = self._group.get(id(es))
+        if grp is None:
+            sibs = es.virtual_process.execution_streams
+            vpq = es.virtual_process.sched_private
+            g = 0 if sibs.index(es) < (len(sibs) + 1) // 2 else 1
+            grp = vpq.groups[min(g, len(vpq.groups) - 1)]
+            self._group[id(es)] = grp
+        return grp
+
+    def flow_init(self, es: Any) -> None:
+        vpq = es.virtual_process.sched_private
+
+        def overflow(items: list, distance: int) -> None:
+            self._group_of(es).push_all(items, distance)
+
+        es.sched_private = HBBuffer(vpq.cap, parent_push=overflow)
+
+    def select(self, es: Any) -> tuple[Any | None, int]:
+        if es.sched_private is not None:
+            t = es.sched_private.try_pop_best(
+                priority=lambda x: x.priority)
+            if t is not None:
+                return t, 0
+            grp = self._group_of(es)
+            t = grp.try_pop_best(priority=lambda x: x.priority)
+            if t is not None:
+                return t, 1
+            for d, sib in enumerate(self._steal_order(es)):
+                if sib.sched_private is None:
+                    continue
+                t = sib.sched_private.steal()
+                if t is not None:
+                    return t, 2 + d
+            vpq = es.virtual_process.sched_private
+            for grp in vpq.groups:
+                t = grp.steal()
+                if t is not None:
+                    return t, 10
+        vpq = es.virtual_process.sched_private
+        with vpq.lock:
+            if vpq.system:
+                return vpq.system.popleft(), 99
+        return None, 0
+
+    def pending_tasks(self, context: Any) -> int:
+        n = super().pending_tasks(context)
+        for vp in context.virtual_processes:
+            if getattr(vp.sched_private, "groups", None):
+                n += sum(len(g) for g in vp.sched_private.groups)
+        return n
+
+
+# ---------------------------------------------------------------------------
 # component registrations (priorities mirror the reference's)
 # ---------------------------------------------------------------------------
 
@@ -333,6 +550,9 @@ _mk_component(LFQModule, 20)
 _mk_component(SPQModule, 18 - 6)   # spq=12 in the reference
 _mk_component(APModule, 12)
 _mk_component(GDModule, 10)
+_mk_component(PBQModule, 4)
+_mk_component(LTQModule, 3)
+_mk_component(LHQModule, 3)
 _mk_component(LLModule, 2)
 _mk_component(LLPModule, 2)
 _mk_component(RNDModule, 1)
