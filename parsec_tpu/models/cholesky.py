@@ -95,10 +95,12 @@ def trsm_tpu_body(es: Any, task: Any, device: Any) -> Any:
     jax, jnp, jsl = _jax()
     lkk = task.data[0].value
     c = task.data[1]
-    # right-solve against Lᵀ: X = B · inv(Lₖₖᵀ)
-    c.value = jsl.solve_triangular(
-        lkk.astype(jnp.float32), c.value.astype(jnp.float32).T,
-        lower=True).T
+    # right-solve against Lᵀ via the explicit triangular inverse — even
+    # standalone (no CSE) this measures faster than the direct rhs solve
+    # on v5e (150ms vs 213ms at nb=1024: XLA specializes the identity-rhs
+    # solve, and the MXU eats the extra matmul); slightly weaker forward
+    # error than substitution on ill-conditioned panels
+    c.value = _trsm_traceable(lkk, c.value)
     c.version += 1
     return c.value
 
@@ -142,9 +144,16 @@ def _potrf_traceable(t):
 
 
 def _trsm_traceable(lkk, c):
+    """X = C · inv(Lₖₖ)ᵀ, computed as (inv(Lₖₖ) · Cᵀ)ᵀ with the inverse
+    from one identity solve.  TPU-first: the substitution loop (slow,
+    sequential) runs once against the identity and the per-tile work is a
+    matmul; in the unrolled lowering XLA CSEs the identical inverse across
+    every TRSM of one panel, so a whole panel pays ONE solve."""
     _, jnp, jsl = _jax()
-    return jsl.solve_triangular(lkk.astype(jnp.float32),
-                                c.astype(jnp.float32).T, lower=True).T
+    lkk = lkk.astype(jnp.float32)
+    linv = jsl.solve_triangular(lkk, jnp.eye(lkk.shape[0], dtype=lkk.dtype),
+                                lower=True)
+    return (linv @ c.astype(jnp.float32).T).T
 
 
 def _syrk_traceable(a, t):

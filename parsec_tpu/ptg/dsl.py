@@ -84,15 +84,25 @@ class FlowBuilder:
         self._deps_out: list[Dep] = []
 
     def input(self, pred: tuple | None = None, data: tuple | None = None,
-              guard: Callable | None = None, dtt: Any = None) -> "FlowBuilder":
+              guard: Callable | None = None, dtt: Any = None,
+              new: bool = False, null: bool = False) -> "FlowBuilder":
         """Add an input arrow.
 
         ``pred=(class_name, flow_name, params_fn)`` for a task predecessor;
-        ``data=(collection_or_name, key_fn)`` for a direct collection read.
-        ``params_fn(g, l) -> dict`` binds the predecessor's locals;
-        ``key_fn(g, l) -> tuple`` the collection key.
+        ``data=(collection_or_name, key_fn)`` for a direct collection read;
+        ``new=True`` for a fresh-tile allocation (JDF ``<- NEW``; the flow
+        needs a declared tile type); ``null=True`` for an explicit no-data
+        input (JDF ``<- NULL``).  ``params_fn(g, l) -> dict`` binds the
+        predecessor's locals; ``key_fn(g, l) -> tuple`` the collection key.
         """
-        self._deps_in.append(self._tcb._mk_dep(pred, data, guard, dtt))
+        if new and dtt is None and self.dtt is None:
+            raise ValueError(
+                f"flow {self.name}: NEW needs a tile type to allocate "
+                f"(pass dtt= on the arrow or declare it on the flow)")
+        self._deps_in.append(self._tcb._mk_dep(pred, data, guard, dtt,
+                                               new=new, null=null))
+        if new and dtt is not None and self.dtt is None:
+            self.dtt = dtt      # NEW allocates at the flow's declared type
         return self
 
     def output(self, succ: tuple | None = None, data: tuple | None = None,
@@ -179,11 +189,17 @@ class TaskClassBuilder:
 
     # -- helpers ------------------------------------------------------------
     def _mk_dep(self, ref: tuple | None, data: tuple | None,
-                guard: Callable | None, dtt: Any) -> Dep:
+                guard: Callable | None, dtt: Any,
+                new: bool = False, null: bool = False) -> Dep:
         g_ns = self._ptg._g_ns
         gfn = None
         if guard is not None:
             gfn = lambda locals_: guard(g_ns(), _ns(locals_))
+        if new or null:
+            # NEW: all targets None — resolve_data_inputs leaves the slot
+            # empty and prepare_input allocates scratch of the flow type;
+            # NULL: the flow explicitly carries no data for these locals
+            return Dep(guard=gfn, dtt=dtt, null=null)
         if ref is not None:
             cls_name, flow_name, params_fn = ref
             tparams = lambda locals_: params_fn(g_ns(), _ns(locals_))

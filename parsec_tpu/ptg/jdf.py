@@ -42,8 +42,9 @@ Grammar notes (vs ``parsec.y``): execution-space ranges are ``lo .. hi`` or
 ``[type = NAME]`` reshape properties — ``NAME`` must resolve (via build
 bindings or the prologue) to a :class:`~parsec_tpu.data.datatype.TileType`,
 and the consumer of that edge observes the datum converted to it
-(read-side reshape, :mod:`parsec_tpu.data.reshape`).  ``NEW``/``NULL``
-targets are not implemented yet.
+(read-side reshape, :mod:`parsec_tpu.data.reshape`).  ``<- NEW [type=T]``
+allocates a fresh tile of type ``T`` (Ex03's first-link form); ``<- NULL``
+declares an explicitly data-less input and ``-> NULL`` drops the datum.
 
 Sanity checking mirrors ``jdf_sanity_checks`` (``jdf.h:68-86``): unknown
 target classes/flows/collections, missing ranges, CTL flows with data
@@ -270,6 +271,21 @@ class JDF:
             if tgt is None:
                 continue
             kind, name, flow, args_src = tgt
+            if kind in ("new", "null"):
+                if ar.direction == "out":
+                    if kind == "new":
+                        raise JDFError(
+                            f"line {ar.line}: NEW is an input-only target")
+                    continue    # `-> NULL`: the datum is dropped — no dep
+                if kind == "new" and dtt is None and fd.access != CTL:
+                    # NEW allocates at the flow's declared type; JDF flows
+                    # declare it through the arrow's [type=...] property
+                    raise JDFError(
+                        f"line {ar.line}: NEW needs a [type = ...] "
+                        f"property naming the tile type to allocate")
+                fb.input(new=(kind == "new"), null=(kind == "null"),
+                         guard=gfn, dtt=dtt)
+                continue
             if kind == "task":
                 t_decl = self.tasks[name]
                 args = [a.strip() for a in _split_top(args_src, ",")]
@@ -330,6 +346,8 @@ class JDF:
                         if tgt is None:
                             continue
                         kind, name, flow, _args = tgt
+                        if kind in ("new", "null"):
+                            continue
                         if kind == "task":
                             if name not in self.tasks:
                                 raise JDFError(
@@ -626,6 +644,10 @@ def _parse_arrows(fd: _FlowDecl, s: str, lineno: int, err) -> None:
 
 
 def _parse_target(s: str, err) -> tuple:
+    if s == "NEW":          # fresh-tile allocation (Ex03's `<- NEW`)
+        return ("new", None, None, None)
+    if s == "NULL":         # explicit no-data endpoint
+        return ("null", None, None, None)
     mt = _RE_TARGET_TASK.match(s)
     if mt:
         return ("task", mt.group(2), mt.group(1), mt.group(3))

@@ -163,6 +163,11 @@ def _analyze(tp) -> dict[str, _ClassInfo]:
                     raise LoweringError(
                         f"{tc.name}.{f.name}: typed dep edges "
                         f"([type=...]) reshape on the dynamic path")
+            for d in f.deps_in:
+                if d.target_class is None and d.data_ref is None:
+                    raise LoweringError(
+                        f"{tc.name}.{f.name}: NEW/NULL input arrows "
+                        f"resolve on the dynamic path")
         infos[tc.name] = _ClassInfo(tc, tasks, kernel)
     return infos
 

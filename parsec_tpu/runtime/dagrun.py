@@ -484,6 +484,8 @@ def _check_eligible(classes) -> None:
             for d in (*f.deps_in, *f.deps_out):
                 if d.dtt is not None:
                     raise _Ineligible   # typed edges reshape dynamically
+            if f.dtt is not None and any(d.null for d in f.deps_in):
+                raise _Ineligible   # NULL-vs-scratch needs per-task guards
 
 
 def _build(tp, builders) -> CompiledDag | None:

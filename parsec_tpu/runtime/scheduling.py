@@ -204,8 +204,10 @@ def prepare_input(es: ExecutionStream, task: Task) -> None:
     for f in tc.flows:
         if f.is_ctl or task.data[f.flow_index] is not None:
             continue
+        if any(d.null and d.active(task.locals) for d in f.deps_in):
+            continue   # explicit NULL arrow: no data for these locals
         if f.dtt is not None:
-            # WRITE-only flow: allocate scratch of the declared tile type
+            # WRITE-only / NEW flow: allocate scratch of the declared type
             import numpy as np
 
             from ..data.data import data_create

@@ -47,23 +47,31 @@ class Dep:
     the data collection (``A(k)`` arrow target).  For an *input* dep the
     fields describe the predecessor symmetrically; ``target_class is None``
     means the flow reads directly from the collection.
+
+    With all targets None the dep is a *NEW* arrow (the flow allocates a
+    fresh tile of its declared type when this dep is active) or, with
+    ``null=True``, a *NULL* arrow (the flow explicitly carries no data) —
+    the JDF ``<- NEW`` / ``<- NULL`` endpoints (``jdf.h`` JDF_VAR special
+    cases).
     """
 
     __slots__ = ("guard", "target_class", "target_flow", "target_params",
-                 "dtt", "data_ref")
+                 "dtt", "data_ref", "null")
 
     def __init__(self, guard: Callable[[dict], bool] | None = None,
                  target_class: str | None = None,
                  target_flow: str | None = None,
                  target_params: Callable[[dict], tuple] | None = None,
                  dtt: Any = None,
-                 data_ref: Callable[[dict], tuple] | None = None) -> None:
+                 data_ref: Callable[[dict], tuple] | None = None,
+                 null: bool = False) -> None:
         self.guard = guard
         self.target_class = target_class
         self.target_flow = target_flow
         self.target_params = target_params
         self.dtt = dtt
         self.data_ref = data_ref  # (collection, key...) accessor for dc edges
+        self.null = null
 
     def active(self, locals_: dict) -> bool:
         return self.guard is None or bool(self.guard(locals_))

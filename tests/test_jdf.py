@@ -388,3 +388,128 @@ T(i)
 BODY
 END
 """, "WRITE flow", V=object())
+
+
+class TestNewNullTargets:
+    """JDF NEW/NULL endpoints (reference jdf.h special targets; Ex03's
+    `<- NEW` first-link form is the SURVEY §7 step-3 exit shape)."""
+
+    def test_ex03_shape_with_new(self):
+        """The reference Ex03_ChainMPI.jdf chain: the first task allocates
+        its datum with NEW, every later task receives it from its
+        predecessor, incrementing as it goes."""
+        import numpy as np
+
+        from parsec_tpu.data.data import TileType
+        from parsec_tpu.runtime import Context
+
+        src = """
+        NB    [type = int]
+        T1    [type = int]
+        SINK  [type = int]
+
+        Task(k)
+          k = 0 .. NB
+          RW A <- (k == 0) ? NEW : A Task(k - 1)  [type = T1]
+               -> (k < NB) ? A Task(k + 1)
+        BODY
+          if k == 0:
+              A[...] = 0
+          else:
+              A[...] = A + 1
+          if k == NB:
+              SINK.append(float(A[0]))
+        END
+        """
+        sink = []
+        tp = parse_jdf(src, "ex03new").build(
+            NB=7, T1=TileType((1,), np.float32), SINK=sink)
+        ctx = Context(nb_cores=0)
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+        ctx.fini()
+        assert sink == [7.0]
+
+    def test_null_input_and_output(self):
+        import numpy as np
+
+        from parsec_tpu.data.data import TileType
+        from parsec_tpu.data_dist.collection import DictCollection
+        from parsec_tpu.runtime import Context
+
+        src = """
+        A     [type = data]
+        SINK  [type = int]
+
+        T(i)
+          i = 0 .. 1
+          : A(0)
+          RW V <- (i == 0) ? A(0) : NULL
+               -> NULL
+        BODY
+          SINK.append(V is None)
+        END
+        """
+        coll = DictCollection("A", dtt=TileType((1,), np.float32),
+                              init_fn=lambda *k: np.zeros(1, np.float32))
+        sink = []
+        tp = parse_jdf(src, "nulls").build(A=coll, SINK=sink)
+        ctx = Context(nb_cores=0)
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+        ctx.fini()
+        assert sorted(sink) == [False, True]   # i=0 got data, i=1 NULL
+
+    def test_new_without_type_rejected(self):
+        src = """
+        NB [type = int]
+
+        T(i)
+          i = 0 .. 0
+          RW V <- NEW
+        BODY
+          pass
+        END
+        """
+        with pytest.raises(JDFError, match="NEW needs"):
+            parse_jdf(src, "badnew").build(NB=1)
+
+    def test_new_as_output_rejected(self):
+        src = """
+        NB [type = int]
+
+        T(i)
+          i = 0 .. 0
+          RW V -> NEW
+        BODY
+          pass
+        END
+        """
+        with pytest.raises(JDFError, match="input-only"):
+            parse_jdf(src, "badout").build(NB=1)
+
+    def test_lowering_refuses_new_null_gracefully(self):
+        import numpy as np
+
+        from parsec_tpu import ptg
+        from parsec_tpu.data.data import TileType
+        from parsec_tpu.ptg.lowering import LoweringError, lower_taskpool
+
+        p = ptg.PTGBuilder("nn", N=2)
+        t = p.task("T", i=ptg.span(0, lambda g, l: g.N - 1))
+        f = t.flow("V", ptg.RW)
+        f.input(new=True, guard=lambda g, l: l.i == 0,
+                dtt=TileType((1,), np.float32))
+        f.input(null=True, guard=lambda g, l: l.i > 0)
+        t.body(lambda es, task, g, l: None, dyld="gemm")
+        with pytest.raises(LoweringError):
+            lower_taskpool(p.build())
+
+    def test_dsl_new_without_type_rejected(self):
+        from parsec_tpu import ptg
+
+        p = ptg.PTGBuilder("nt", N=1)
+        t = p.task("T", i=ptg.span(0, 0))
+        f = t.flow("V", ptg.RW)
+        with pytest.raises(ValueError, match="NEW needs"):
+            f.input(new=True)
