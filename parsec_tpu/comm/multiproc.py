@@ -1,0 +1,181 @@
+"""N-process harness over the socket fabric — the ``mpiexec -np N`` analog.
+
+Where :func:`~parsec_tpu.comm.multirank.run_multirank` runs ranks as
+threads over an in-process fabric, this launcher spawns each rank as its
+own OS **process**, connected by the TCP socket fabric
+(:mod:`parsec_tpu.comm.socket_fabric`) — genuinely separate interpreters,
+address spaces, and GILs, exactly what a multi-host DCN deployment looks
+like (set ``PARSEC_TPU_HOSTS`` and launch the same entry on each host).
+
+The body function must be *importable* (``"pkg.module:function"`` or
+``"path/to/file.py:function"``) with the ``fn(ctx, rank, nranks) ->
+picklable`` signature run_multirank uses.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+from typing import Any
+
+
+def _free_port_base(nranks: int) -> int:
+    """A base port whose whole [base, base+nranks) range binds (probed
+    port-by-port; the range cannot be reserved atomically, so callers
+    still retry on a lost race)."""
+    for _attempt in range(50):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        base = probe.getsockname()[1]
+        probe.close()
+        if base + nranks >= 65000:
+            continue
+        ok = True
+        for r in range(nranks):
+            s = socket.socket()
+            try:
+                s.bind(("127.0.0.1", base + r))
+            except OSError:
+                ok = False
+                break
+            finally:
+                s.close()
+        if ok:
+            return base
+    raise RuntimeError("no free port range found")
+
+
+def run_multiproc(nranks: int, target: str, timeout: float = 180.0,
+                  nb_cores: int = 0) -> list[Any]:
+    """Run ``target`` on ``nranks`` subprocess ranks; returns the per-rank
+    results.  Retries once on a lost port-range race (a bind collision
+    surfaces as one rank failing, or as a timeout of the survivors)."""
+    try:
+        return _run_multiproc(nranks, target, timeout, nb_cores)
+    except (RuntimeError, TimeoutError) as e:
+        if "Address already in use" not in str(e):
+            raise
+        return _run_multiproc(nranks, target, timeout, nb_cores)
+
+
+def _run_multiproc(nranks: int, target: str, timeout: float,
+                   nb_cores: int) -> list[Any]:
+    base = _free_port_base(nranks)
+    tmp = tempfile.mkdtemp(prefix="parsec_mp_")
+    env = dict(os.environ)
+    # subprocess ranks must not grab the bench TPU (or a TPU plugin that
+    # admits one process only): force plain CPU interpreters.  All ranks
+    # are local here, so a leftover multi-host spec must not leak in.
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PJRT_LIBRARY_PATH", None)
+    env.pop("PARSEC_TPU_HOSTS", None)
+    env["PARSEC_MP_NRANKS"] = str(nranks)
+    env["PARSEC_MP_TARGET"] = target
+    env["PARSEC_MP_BASE_PORT"] = str(base)
+    env["PARSEC_MP_NB_CORES"] = str(nb_cores)
+    env["PARSEC_MP_TIMEOUT"] = str(timeout)
+    procs: list[subprocess.Popen] = []
+    logs: list[str] = []
+    try:
+        for r in range(nranks):
+            e = dict(env)
+            e["PARSEC_MP_RANK"] = str(r)
+            e["PARSEC_MP_RESULT"] = os.path.join(tmp, f"rank{r}.pkl")
+            log = os.path.join(tmp, f"rank{r}.log")
+            logs.append(log)
+            with open(log, "wb") as lf:
+                # per-rank log FILES, not pipes: a chatty rank must never
+                # block on a full pipe the parent isn't draining yet
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-c",
+                     "from parsec_tpu.comm.multiproc import _rank_main; "
+                     "_rank_main()"],
+                    env=e, cwd=os.getcwd(), stdout=lf,
+                    stderr=subprocess.STDOUT))
+        failed = []
+        for r, p in enumerate(procs):
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                for q in procs:
+                    q.wait()     # reap: no zombies on the timeout path
+                tails = _tails(logs)
+                raise TimeoutError(
+                    f"rank {r} did not finish within {timeout}s\n{tails}")
+            if p.returncode != 0:
+                failed.append(r)
+        if failed:
+            tails = _tails([logs[r] for r in failed])
+            raise RuntimeError(
+                f"rank(s) {failed} failed:\n{tails}")
+        results: list[Any] = []
+        for r in range(nranks):
+            with open(os.path.join(tmp, f"rank{r}.pkl"), "rb") as f:
+                results.append(pickle.load(f))
+        return results
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _tails(logs: list[str], nbytes: int = 2000) -> str:
+    out = []
+    for log in logs:
+        try:
+            with open(log, "rb") as f:
+                data = f.read()[-nbytes:]
+            out.append(f"--- {os.path.basename(log)} ---\n"
+                       + data.decode(errors="replace"))
+        except OSError:
+            pass
+    return "\n".join(out)
+
+
+def _rank_main() -> None:
+    """Subprocess entry: build the socket-backed runtime and run the body."""
+    # force-CPU before jax can load a TPU plugin (mirrors tests/conftest)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+    import importlib
+    import importlib.util
+
+    from ..runtime.context import Context
+    from .remote_dep import RemoteDepEngine
+    from .socket_fabric import SocketCommEngine, SocketFabric
+
+    rank = int(os.environ["PARSEC_MP_RANK"])
+    nranks = int(os.environ["PARSEC_MP_NRANKS"])
+    base = int(os.environ["PARSEC_MP_BASE_PORT"])
+    nb_cores = int(os.environ["PARSEC_MP_NB_CORES"])
+    timeout = float(os.environ["PARSEC_MP_TIMEOUT"])
+    mod_name, fn_name = os.environ["PARSEC_MP_TARGET"].rsplit(":", 1)
+    if mod_name.endswith(".py"):    # file-path form: "dir/bodies.py:fn"
+        spec = importlib.util.spec_from_file_location("_mp_target", mod_name)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    else:
+        mod = importlib.import_module(mod_name)
+    fn = getattr(mod, fn_name)
+
+    fabric = SocketFabric(nranks, rank, base_port=base)
+    ctx = Context(nb_cores=nb_cores, nb_ranks=nranks, my_rank=rank)
+    eng = RemoteDepEngine(ctx, SocketCommEngine(fabric))
+    ctx.start()
+    result = fn(ctx, rank, nranks)
+    # context-level drain before teardown (the run_multirank discipline)
+    eng.quiesce(timeout=timeout / 2)
+    ctx.fini()
+    with open(os.environ["PARSEC_MP_RESULT"], "wb") as f:
+        pickle.dump(result, f)

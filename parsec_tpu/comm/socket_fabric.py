@@ -1,0 +1,210 @@
+"""Socket transport: the multi-PROCESS comm backend (the DCN tier).
+
+SURVEY §5.8 maps the reference's transport tiers onto TPU pods as
+ICI (device-to-device, :mod:`device_fabric`) for in-pod payloads and
+DCN/host networking across pods.  This module is the DCN tier: each rank
+is its own OS process, active messages and rendezvous payloads move over
+TCP, and the entire protocol stack above the engine vtable — remote-dep
+activation, propagation trees, coalescing, termdet waves, DTD pushes —
+runs unchanged (``RemoteDepEngine`` never learns which fabric it rides).
+
+Wire format: length-prefixed pickles of ``(tag, src, payload)`` frames.
+Topology: rank *i* listens on ``base_port + i``; outgoing connections are
+made lazily with connect-retry (peers boot in any order).  The host list
+defaults to localhost (the oversubscribed test form — real multi-host runs
+set ``PARSEC_TPU_HOSTS=h0,h1,...``).
+
+Use :func:`parsec_tpu.comm.multiproc.run_multiproc` to launch N subprocess
+ranks and collect their results — the ``mpiexec -np N`` analog.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from ..core.params import params as _params
+from .engine import InprocCommEngine
+
+_params.register("comm_socket_base_port", 39100,
+                 "first TCP port of the socket fabric (rank i listens on "
+                 "base+i)")
+
+_LEN = struct.Struct("<Q")
+
+
+def _hosts(nranks: int) -> list[str]:
+    spec = os.environ.get("PARSEC_TPU_HOSTS", "")
+    hosts = [h for h in spec.split(",") if h.strip()]
+    if not hosts:
+        hosts = ["127.0.0.1"]
+    return [hosts[r % len(hosts)] for r in range(nranks)]
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class SocketFabric:
+    """One process's endpoint of the TCP mesh (quacks like InprocFabric
+    for the local rank: deliver / drain / pending)."""
+
+    def __init__(self, nranks: int, rank: int,
+                 base_port: int | None = None) -> None:
+        self.nranks = nranks
+        self.rank = rank
+        self.base_port = base_port if base_port is not None else \
+            _params.get("comm_socket_base_port")
+        self.hosts = _hosts(nranks)
+        self._inbox: deque = deque()
+        self._ilock = threading.Lock()
+        self._peers: dict[int, list] = {}   # dst -> [sock|None, send-lock]
+        self._plock = threading.Lock()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", self.base_port + rank))
+        self._listener.listen(nranks)
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_main, daemon=True,
+            name=f"parsec-sock-accept-r{rank}")
+        self._accept_thread.start()
+
+    # ------------------------------------------------------------ receive
+    def _accept_main(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._listener.settimeout(0.2)
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._recv_main, args=(conn,),
+                             daemon=True).start()
+
+    def _recv_main(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while not self._stop.is_set():
+            try:
+                head = _recv_exact(conn, _LEN.size)
+                if head is None:
+                    return
+                body = _recv_exact(conn, _LEN.unpack(head)[0])
+                if body is None:
+                    return
+                frame = pickle.loads(body)
+            except OSError:
+                return
+            except Exception as e:
+                # a corrupt/unimportable payload must be VISIBLE, not a
+                # silently dead receiver thread with a stalled connection
+                from ..core.output import warning
+                warning(f"socket fabric rank {self.rank}: dropping "
+                        f"connection on undecodable frame: {e!r}")
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            with self._ilock:
+                self._inbox.append(frame)
+
+    # --------------------------------------------------------------- send
+    def _peer(self, dst: int) -> tuple[socket.socket | None, threading.Lock]:
+        """The (socket, send-lock) pair for ``dst``.  The global lock only
+        installs the per-destination slot; the (up to 30s) connect-retry
+        runs under the slot's own lock, so a slow-booting peer never
+        stalls sends to peers that are already connected."""
+        with self._plock:
+            ent = self._peers.get(dst)
+            if ent is None:
+                ent = self._peers[dst] = [None, threading.Lock()]
+        with ent[1]:
+            if ent[0] is None:
+                deadline = time.monotonic() + 30.0
+                while True:
+                    try:
+                        s = socket.create_connection(
+                            (self.hosts[dst], self.base_port + dst),
+                            timeout=2.0)
+                        break
+                    except OSError:
+                        if time.monotonic() > deadline:
+                            raise
+                        time.sleep(0.05)   # peer still booting
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                ent[0] = s
+        return ent[0], ent[1]
+
+    def deliver(self, dst: int, tag: int, src: int, payload: Any) -> None:
+        if dst == self.rank:
+            with self._ilock:
+                self._inbox.append((tag, src, payload))
+            return
+        s, lock = self._peer(dst)
+        with lock:    # frames must not interleave on one connection
+            _send_frame(s, (tag, src, payload))
+
+    # ----------------------------------------------------- drain (local)
+    def drain(self, rank: int, limit: int = 64) -> list[tuple]:
+        assert rank == self.rank
+        out = []
+        with self._ilock:
+            while self._inbox and len(out) < limit:
+                out.append(self._inbox.popleft())
+        return out
+
+    def pending(self, rank: int) -> int:
+        assert rank == self.rank
+        with self._ilock:
+            return len(self._inbox)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._plock:
+            for ent in self._peers.values():
+                if ent[0] is not None:
+                    try:
+                        ent[0].close()
+                    except OSError:
+                        pass
+            self._peers.clear()
+
+
+class SocketCommEngine(InprocCommEngine):
+    """The engine vtable over :class:`SocketFabric`.
+
+    :class:`SocketFabric` exposes the same deliver/drain/pending surface
+    the in-process fabric does, so the whole AM + rendezvous-GET + barrier
+    protocol is inherited verbatim — the engine cannot tell whether its
+    bytes cross a deque or a TCP connection, which is exactly the vtable
+    discipline the reference's comm engines follow
+    (``parsec_comm_engine.h:176-199``)."""
+
+    def __init__(self, fabric: SocketFabric) -> None:
+        super().__init__(fabric, fabric.rank)
+
+    def fini(self) -> None:
+        self.fabric.close()

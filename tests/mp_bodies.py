@@ -1,0 +1,57 @@
+"""Rank bodies for the multi-process (socket fabric) tests — kept in a
+plain module so subprocess ranks can import them by file path."""
+
+import numpy as np
+
+
+def chain_body(ctx, rank, nranks):
+    """Ex03 chain across PROCESSES: the tile hops rank to rank over TCP."""
+    from parsec_tpu import ptg
+    from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
+
+    NB = 2 * nranks
+    V = VectorTwoDimCyclic("V", lm=NB, mb=4, P=nranks, myrank=rank,
+                           init_fn=lambda m, size: np.zeros(size, np.float32))
+    p = ptg.PTGBuilder("chain", V=V, NB=NB)
+    t = p.task("T", i=ptg.span(0, lambda g, l: g.NB - 1))
+    t.affinity("V", lambda g, l: (l.i,))
+    f = t.flow("A", ptg.RW)
+    f.input(data=("V", lambda g, l: (0,)), guard=lambda g, l: l.i == 0)
+    f.input(pred=("T", "A", lambda g, l: {"i": l.i - 1}),
+            guard=lambda g, l: l.i > 0)
+    f.output(succ=("T", "A", lambda g, l: {"i": l.i + 1}),
+             guard=lambda g, l: l.i < g.NB - 1)
+    f.output(data=("V", lambda g, l: (0,)),
+             guard=lambda g, l: l.i == g.NB - 1)
+
+    @t.body
+    def body(es, task, g, l):
+        a = task.flow_data("A")
+        a.value = np.asarray(a.value) + 1
+
+    ctx.add_taskpool(p.build())
+    ctx.wait(timeout=60)
+    ctx.comm_barrier()
+    if rank == 0:
+        return float(np.asarray(V.data_of(0).newest_copy().value)[0])
+    return None
+
+
+def gemm_body(ctx, rank, nranks):
+    """Block-cyclic GEMM with remote deps over the socket fabric."""
+    from parsec_tpu.data_dist.matrix import TwoDimBlockCyclic
+    from parsec_tpu.models.tiled_gemm import tiled_gemm_ptg
+
+    n, nb = 64, 16
+    rng = np.random.RandomState(23)
+    a = rng.randn(n, n).astype(np.float32)
+    b = rng.randn(n, n).astype(np.float32)
+    P = 2 if nranks % 2 == 0 else 1
+    Q = nranks // P
+    A = TwoDimBlockCyclic.from_dense("A", a, nb, nb, P=P, Q=Q, myrank=rank)
+    B = TwoDimBlockCyclic.from_dense("B", b, nb, nb, P=P, Q=Q, myrank=rank)
+    C = TwoDimBlockCyclic("C", n, n, nb, nb, P=P, Q=Q, myrank=rank)
+    ctx.add_taskpool(tiled_gemm_ptg(A, B, C, devices="cpu"))
+    ctx.wait(timeout=120)
+    ctx.comm_barrier()
+    return C.to_dense()    # this rank's tiles; caller assembles

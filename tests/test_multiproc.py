@@ -1,0 +1,37 @@
+"""Multi-process ranks over the TCP socket fabric (the DCN tier): the same
+remote-dep protocol the inproc/device tests exercise, but across genuinely
+separate interpreters — the mpiexec-analog deployment shape."""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm.multiproc import run_multiproc
+
+BODIES = str(pathlib.Path(__file__).parent / "mp_bodies.py")
+
+
+@pytest.mark.parametrize("nranks", [2, 3])
+def test_chain_across_processes(nranks):
+    res = run_multiproc(nranks, f"{BODIES}:chain_body", timeout=120)
+    assert res[0] == 2 * nranks
+    assert res[1:] == [None] * (nranks - 1)
+
+
+def test_gemm_across_processes():
+    nranks = 4
+    res = run_multiproc(nranks, f"{BODIES}:gemm_body", timeout=180)
+    n = 64
+    rng = np.random.RandomState(23)
+    a = rng.randn(n, n).astype(np.float32)
+    b = rng.randn(n, n).astype(np.float32)
+    got = np.zeros((n, n), np.float32)
+    for part in res:
+        got += part
+    np.testing.assert_allclose(got, a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_failed_rank_surfaces():
+    with pytest.raises((RuntimeError, TimeoutError)):
+        run_multiproc(2, f"{BODIES}:no_such_body", timeout=60)
