@@ -98,24 +98,36 @@ def _run_multiproc(nranks: int, target: str, timeout: float,
                      "_rank_main()"],
                     env=e, cwd=os.getcwd(), stdout=lf,
                     stderr=subprocess.STDOUT))
-        failed = []
-        for r, p in enumerate(procs):
-            try:
-                p.wait(timeout=timeout)
-            except subprocess.TimeoutExpired:
+        # one shared deadline, polled: the first nonzero exit kills the
+        # survivors immediately (they would otherwise hang waiting for the
+        # dead rank's activations until their own timeouts)
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        failed: list[int] = []
+        while True:
+            codes = [p.poll() for p in procs]
+            failed = [r for r, c in enumerate(codes)
+                      if c is not None and c != 0]
+            if failed or all(c is not None for c in codes):
+                break
+            if _time.monotonic() > deadline:
                 for q in procs:
                     q.kill()
                 for q in procs:
                     q.wait()     # reap: no zombies on the timeout path
-                tails = _tails(logs)
+                hung = [r for r, c in enumerate(codes) if c is None]
                 raise TimeoutError(
-                    f"rank {r} did not finish within {timeout}s\n{tails}")
-            if p.returncode != 0:
-                failed.append(r)
+                    f"rank(s) {hung} did not finish within {timeout}s\n"
+                    + _tails(logs))
+            _time.sleep(0.05)
         if failed:
-            tails = _tails([logs[r] for r in failed])
+            for q in procs:
+                q.kill()
+            for q in procs:
+                q.wait()
             raise RuntimeError(
-                f"rank(s) {failed} failed:\n{tails}")
+                f"rank(s) {failed} failed:\n"
+                + _tails([logs[r] for r in failed]))
         results: list[Any] = []
         for r in range(nranks):
             with open(os.path.join(tmp, f"rank{r}.pkl"), "rb") as f:

@@ -47,9 +47,9 @@ def _hosts(nranks: int) -> list[str]:
     return [hosts[r % len(hosts)] for r in range(nranks)]
 
 
-def _send_frame(sock: socket.socket, obj: Any) -> None:
+def _frame(obj: Any) -> bytes:
     data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(data)) + data)
+    return _LEN.pack(len(data)) + data
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
@@ -77,6 +77,7 @@ class SocketFabric:
         self._ilock = threading.Lock()
         self._peers: dict[int, list] = {}   # dst -> [sock|None, send-lock]
         self._plock = threading.Lock()
+        self._accepted: list[socket.socket] = []   # inbound conns, for close
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind(("0.0.0.0", self.base_port + rank))
@@ -97,6 +98,8 @@ class SocketFabric:
                 continue
             except OSError:
                 return
+            with self._plock:
+                self._accepted.append(conn)
             threading.Thread(target=self._recv_main, args=(conn,),
                              daemon=True).start()
 
@@ -159,9 +162,10 @@ class SocketFabric:
             with self._ilock:
                 self._inbox.append((tag, src, payload))
             return
+        data = _frame((tag, src, payload))   # pickle OUTSIDE the send lock
         s, lock = self._peer(dst)
         with lock:    # frames must not interleave on one connection
-            _send_frame(s, (tag, src, payload))
+            s.sendall(data)
 
     # ----------------------------------------------------- drain (local)
     def drain(self, rank: int, limit: int = 64) -> list[tuple]:
@@ -191,6 +195,15 @@ class SocketFabric:
                     except OSError:
                         pass
             self._peers.clear()
+            # closing inbound conns unblocks their recv threads (recv
+            # returns/raises, _recv_main exits) — no thread/fd leak when
+            # fabrics are created and torn down repeatedly in one process
+            for conn in self._accepted:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._accepted.clear()
 
 
 class SocketCommEngine(InprocCommEngine):

@@ -1,0 +1,127 @@
+"""Checkpoint/restore of data collections (SURVEY §5.4).
+
+The reference has NO checkpoint subsystem (§5.4 notes its absence); this
+module goes past parity with the coarse-grained application-driven form
+the task-based-runtime community uses: between taskpool executions, the
+collections ARE the whole program state (taskpools are deterministic
+replayable programs over them), so saving tiles + versions at a phase
+boundary and restoring them later is a complete restart story:
+
+    run(phase1); save_collections(path, A, B)     # checkpoint
+    ...crash...
+    restore_collections(path, A, B); run(phase2)  # resume
+
+Format: one ``.npz`` per rank (tiles this rank owns) plus a JSON header
+with versions and geometry — restore refuses silently-mismatched
+collections.  Multi-rank: every rank saves/restores its own shard
+(``path`` grows a ``.rankN`` suffix), the same SPMD discipline orbax uses
+for sharded jax checkpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+__all__ = ["save_collections", "restore_collections", "CheckpointError"]
+
+
+class CheckpointError(RuntimeError):
+    pass
+
+
+def _rank_path(path: str, rank: int, nranks: int) -> str:
+    return path if nranks <= 1 else f"{path}.rank{rank}"
+
+
+def _own_keys(dc) -> list[tuple]:
+    from ..data_dist.collection import enumerate_keys
+    keys = enumerate_keys(dc)
+    if getattr(dc, "nodes", 1) > 1:
+        keys = [k for k in keys if dc.rank_of(*k) == dc.myrank]
+    return keys
+
+
+def save_collections(path: str, *collections: Any,
+                     meta: dict | None = None) -> str:
+    """Snapshot every owned tile (+ version) of each collection.
+
+    Returns the file actually written (rank-suffixed when distributed).
+    """
+    if not collections:
+        raise CheckpointError("nothing to checkpoint")
+    nranks = max(getattr(dc, "nodes", 1) for dc in collections)
+    rank = max(getattr(dc, "myrank", 0) for dc in collections)
+    out = _rank_path(path, rank, nranks)
+    names = [dc.name for dc in collections]
+    if len(set(names)) != len(names):
+        raise CheckpointError(f"duplicate collection names: {names} — "
+                              f"the archive is keyed by name")
+    arrays: dict[str, np.ndarray] = {}
+    header: dict[str, Any] = {"rank": rank, "nranks": nranks,
+                              "collections": {}, "meta": meta or {}}
+    for dc in collections:
+        entry = {"keys": [], "versions": []}
+        for i, k in enumerate(_own_keys(dc)):
+            copy = dc.data_of(*k).newest_copy()
+            if copy is None:
+                raise CheckpointError(f"{dc.name}{k}: no valid copy")
+            arrays[f"{dc.name}:{i}"] = np.asarray(copy.value)
+            entry["keys"].append(list(k))
+            entry["versions"].append(copy.version)
+        header["collections"][dc.name] = entry
+    arrays["__header__"] = np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8)
+    os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+    np.savez_compressed(out + ".tmp.npz", **arrays)
+    os.replace(out + ".tmp.npz", out)    # atomic publish: no torn files
+    return out
+
+
+def restore_collections(path: str, *collections: Any) -> dict:
+    """Load a snapshot back into the collections' home copies; returns the
+    checkpoint's ``meta`` dict."""
+    if not collections:
+        raise CheckpointError("nothing to restore")
+    nranks = max(getattr(dc, "nodes", 1) for dc in collections)
+    rank = max(getattr(dc, "myrank", 0) for dc in collections)
+    src = _rank_path(path, rank, nranks)
+    with np.load(src) as z:
+        header = json.loads(bytes(z["__header__"]).decode())
+        if header["nranks"] != nranks or header["rank"] != rank:
+            raise CheckpointError(
+                f"{src}: checkpoint is rank {header['rank']}/"
+                f"{header['nranks']}, collections are {rank}/{nranks}")
+        for dc in collections:
+            entry = header["collections"].get(dc.name)
+            if entry is None:
+                raise CheckpointError(f"{src}: no collection {dc.name!r}")
+            own = _own_keys(dc)
+            keys = [tuple(k) for k in entry["keys"]]
+            if keys != own:
+                raise CheckpointError(
+                    f"{dc.name}: geometry/distribution changed since the "
+                    f"checkpoint ({len(keys)} saved vs {len(own)} owned "
+                    f"tiles)")
+            for i, (k, ver) in enumerate(zip(keys, entry["versions"])):
+                value = z[f"{dc.name}:{i}"]
+                datum = dc.data_of(*k)
+                home = datum.get_copy(0)
+                if home is None:
+                    raise CheckpointError(f"{dc.name}{k}: no home copy")
+                if value.shape != np.asarray(home.value).shape:
+                    raise CheckpointError(
+                        f"{dc.name}{k}: tile shape changed "
+                        f"({value.shape} vs {np.asarray(home.value).shape})")
+                home.value = value.copy()
+                home.version = ver
+                # a device copy cached before the restore would otherwise
+                # keep serving pre-restore data (its version still beats
+                # the rewound home) — drop every non-home copy
+                for idx in [i2 for i2 in datum.device_copies
+                            if i2 != home.device_index]:
+                    datum.detach_copy(idx)
+        return header["meta"]

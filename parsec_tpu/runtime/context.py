@@ -16,6 +16,7 @@ path (``scheduling.c:775-784``) and the mode the TPU device manager favors
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Callable
@@ -32,6 +33,9 @@ from .taskpool import Taskpool
 
 _params.register("runtime_num_cores", 0,
                         "worker threads (0 = caller-driven)")
+_params.register("runtime_bind_threads", False,
+                 "pin worker threads to cores round-robin "
+                 "(parsec_bind / hwloc binding analog; Linux only)")
 _params.register("sched", "lfq", "scheduler component to use")
 _params.register("termdet", "", "termination detector override")
 _params.register("runtime_nb_vp", 1, "number of virtual processes")
@@ -200,8 +204,22 @@ class Context:
         self.scheduler.remove(self)
 
     # ------------------------------------------------------- progress loops
+    def _bind_worker(self, es: ExecutionStream) -> None:
+        """Pin this worker to a core (the hwloc thread-binding analog,
+        ``parsec_hwloc_bind_on_core_index``): round-robin over the
+        affinity mask the process started with."""
+        if not _params.get("runtime_bind_threads"):
+            return
+        try:
+            allowed = sorted(os.sched_getaffinity(0))
+            core = allowed[es.th_id % len(allowed)]
+            os.sched_setaffinity(0, {core})
+        except (AttributeError, OSError):
+            pass    # non-Linux or restricted: binding is best-effort
+
     def _worker_main(self, es: ExecutionStream) -> None:
         es.owner_ident = threading.get_ident()
+        self._bind_worker(es)
         self._start_barrier.wait()
         backoff = Backoff()
         while True:

@@ -1,0 +1,121 @@
+"""Checkpoint/restore (SURVEY §5.4 — beyond the reference, which has
+none): collections are the whole inter-phase program state, so snapshot +
+restore + replay is a complete restart story."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm import run_multirank
+from parsec_tpu.data.checkpoint import (CheckpointError, restore_collections,
+                                        save_collections)
+from parsec_tpu.data_dist.matrix import TwoDimBlockCyclic
+from parsec_tpu.models.tiled_gemm import tiled_gemm_ptg
+from parsec_tpu.runtime import Context
+
+
+def mk(n=32, nb=8, seed=5, **kw):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(n, n).astype(np.float32)
+    b = rng.randn(n, n).astype(np.float32)
+    A = TwoDimBlockCyclic.from_dense("A", a, nb, nb, **kw)
+    B = TwoDimBlockCyclic.from_dense("B", b, nb, nb, **kw)
+    C = TwoDimBlockCyclic("C", n, n, nb, nb, **kw)
+    return a, b, A, B, C
+
+
+def run_gemm(A, B, C):
+    ctx = Context(nb_cores=0)
+    ctx.add_taskpool(tiled_gemm_ptg(A, B, C, devices="cpu"))
+    ctx.wait(timeout=60)
+    ctx.fini()
+
+
+class TestRoundTrip:
+    def test_save_restore(self, tmp_path):
+        a, b, A, B, C = mk()
+        run_gemm(A, B, C)
+        p = str(tmp_path / "ck.npz")
+        save_collections(p, C, meta={"phase": 1})
+        # clobber, then restore
+        for m in range(C.mt):
+            for n_ in range(C.nt):
+                C.data_of(m, n_).newest_copy().value[:] = -1.0
+        meta = restore_collections(p, C)
+        assert meta == {"phase": 1}
+        np.testing.assert_allclose(C.to_dense(), a @ b, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_crash_resume_equals_uninterrupted(self, tmp_path):
+        """Two-phase app: C = A·B then D = C·B.  Checkpoint after phase 1,
+        'crash' (fresh collections), restore, run phase 2 — the result must
+        equal the uninterrupted run."""
+        p = str(tmp_path / "phase1.npz")
+        a, b, A, B, C = mk()
+        run_gemm(A, B, C)
+        save_collections(p, C)
+        uninterrupted = TwoDimBlockCyclic("D", 32, 32, 8, 8)
+        run_gemm(C, B, uninterrupted)
+
+        # crash: all state lost; rebuild collections, restore phase 1
+        a2, b2, A2, B2, C2 = mk()
+        restore_collections(p, C2)
+        D2 = TwoDimBlockCyclic("D2", 32, 32, 8, 8)
+        run_gemm(C2, B2, D2)
+        np.testing.assert_allclose(D2.to_dense(), uninterrupted.to_dense(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_versions_roundtrip(self, tmp_path):
+        _, _, A, B, C = mk()
+        run_gemm(A, B, C)
+        ver = C.data_of(0, 0).newest_copy().version
+        p = str(tmp_path / "v.npz")
+        save_collections(p, C)
+        C.data_of(0, 0).newest_copy().version = 999
+        restore_collections(p, C)
+        assert C.data_of(0, 0).newest_copy().version == ver
+
+
+class TestValidation:
+    def test_geometry_mismatch_refused(self, tmp_path):
+        _, _, A, _, _ = mk()
+        p = str(tmp_path / "g.npz")
+        save_collections(p, A)
+        other = TwoDimBlockCyclic("A", 16, 16, 8, 8)   # smaller grid
+        with pytest.raises(CheckpointError, match="geometry"):
+            restore_collections(p, other)
+
+    def test_missing_collection_refused(self, tmp_path):
+        _, _, A, B, _ = mk()
+        p = str(tmp_path / "m.npz")
+        save_collections(p, A)
+        with pytest.raises(CheckpointError, match="no collection"):
+            restore_collections(p, B)
+
+
+class TestMultiRank:
+    def test_per_rank_shards(self, tmp_path):
+        """Each rank saves/restores only the tiles it owns."""
+        p = str(tmp_path / "dist.npz")
+
+        def body(ctx, rank, nranks):
+            a, b, A, B, C = mk(P=2, Q=2, myrank=rank)
+            ctx.add_taskpool(tiled_gemm_ptg(A, B, C, devices="cpu"))
+            ctx.wait(timeout=60)
+            ctx.comm_barrier()
+            out = save_collections(p, C)
+            # clobber the owned tiles, restore, verify
+            for m in range(C.mt):
+                for n_ in range(C.nt):
+                    if C.rank_of(m, n_) == rank:
+                        C.data_of(m, n_).newest_copy().value[:] = -1.0
+            restore_collections(p, C)
+            return (out, C.to_dense())
+
+        res = run_multirank(4, body)
+        paths = {r[0] for r in res}
+        assert len(paths) == 4      # one shard file per rank
+        a, b, *_ = mk()
+        got = np.zeros((32, 32), np.float32)
+        for _, part in res:
+            got += part
+        np.testing.assert_allclose(got, a @ b, rtol=1e-4, atol=1e-4)

@@ -268,3 +268,18 @@ class TestParanoid:
         ctx.wait(timeout=30)
         ctx.fini()
         assert len(trace) == 4
+
+
+class TestThreadBinding:
+    def test_bound_workers_run(self, param):
+        """runtime_bind_threads pins workers round-robin (best-effort);
+        the run must complete and execute every task either way."""
+        param("runtime_bind_threads", True)
+        param("runtime_dag_compile", False)
+        trace = []
+        ctx = Context(nb_cores=2)
+        ctx.add_taskpool(_small_pool(trace))
+        ctx.start()
+        ctx.wait(timeout=30)
+        ctx.fini()
+        assert len(trace) == 4
