@@ -100,6 +100,15 @@ class SocketFabric:
                 return
             with self._plock:
                 self._accepted.append(conn)
+            if self._stop.is_set():
+                # raced with close(): it may have cleared _accepted before
+                # our append — clean up here instead of leaking the conn
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                    conn.close()
+                except OSError:
+                    pass
+                return
             threading.Thread(target=self._recv_main, args=(conn,),
                              daemon=True).start()
 
@@ -191,14 +200,23 @@ class SocketFabric:
             for ent in self._peers.values():
                 if ent[0] is not None:
                     try:
+                        ent[0].shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
                         ent[0].close()
                     except OSError:
                         pass
             self._peers.clear()
-            # closing inbound conns unblocks their recv threads (recv
-            # returns/raises, _recv_main exits) — no thread/fd leak when
-            # fabrics are created and torn down repeatedly in one process
+            # shutdown() (not just close()) unblocks recv threads parked in
+            # recv(2) — close alone only drops the fd reference while the
+            # syscall keeps blocking — so _recv_main exits and no
+            # thread/fd accumulates across fabric create/teardown cycles
             for conn in self._accepted:
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
                 try:
                     conn.close()
                 except OSError:

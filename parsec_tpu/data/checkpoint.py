@@ -112,16 +112,27 @@ def restore_collections(path: str, *collections: Any) -> dict:
                 home = datum.get_copy(0)
                 if home is None:
                     raise CheckpointError(f"{dc.name}{k}: no home copy")
-                if value.shape != np.asarray(home.value).shape:
+                cur = np.asarray(home.value)
+                if value.shape != cur.shape:
                     raise CheckpointError(
                         f"{dc.name}{k}: tile shape changed "
-                        f"({value.shape} vs {np.asarray(home.value).shape})")
+                        f"({value.shape} vs {cur.shape})")
+                if value.dtype != cur.dtype:
+                    raise CheckpointError(
+                        f"{dc.name}{k}: tile dtype changed "
+                        f"({value.dtype} vs {cur.dtype})")
                 home.value = value.copy()
                 home.version = ver
                 # a device copy cached before the restore would otherwise
                 # keep serving pre-restore data (its version still beats
-                # the rewound home) — drop every non-home copy
+                # the rewound home) — invalidate AND detach every non-home
+                # copy: a device LRU may still hold a reference, and its
+                # eviction writeback must see INVALID, never OWNED
+                from .data import COHERENCY_INVALID
                 for idx in [i2 for i2 in datum.device_copies
                             if i2 != home.device_index]:
+                    stale = datum.get_copy(idx)
+                    if stale is not None:
+                        stale.coherency = COHERENCY_INVALID
                     datum.detach_copy(idx)
         return header["meta"]
