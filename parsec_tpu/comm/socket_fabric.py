@@ -103,8 +103,13 @@ class SocketFabric:
             if self._stop.is_set():
                 # raced with close(): it may have cleared _accepted before
                 # our append — clean up here instead of leaking the conn
+                # (separate try blocks: shutdown of a dead peer raises
+                # ENOTCONN and must not skip the close)
                 try:
                     conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
                     conn.close()
                 except OSError:
                     pass

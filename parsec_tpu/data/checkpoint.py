@@ -26,6 +26,8 @@ from typing import Any
 
 import numpy as np
 
+from .data import COHERENCY_INVALID
+
 __all__ = ["save_collections", "restore_collections", "CheckpointError"]
 
 
@@ -128,7 +130,6 @@ def restore_collections(path: str, *collections: Any) -> dict:
                 # the rewound home) — invalidate AND detach every non-home
                 # copy: a device LRU may still hold a reference, and its
                 # eviction writeback must see INVALID, never OWNED
-                from .data import COHERENCY_INVALID
                 for idx in [i2 for i2 in datum.device_copies
                             if i2 != home.device_index]:
                     stale = datum.get_copy(idx)

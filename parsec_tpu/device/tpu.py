@@ -46,6 +46,9 @@ _params.register("device_tpu_batch", True,
                  "stack same-class pending tasks into one vmapped dispatch")
 _params.register("device_tpu_batch_max", 64,
                  "largest task batch a single vmapped dispatch may service")
+_params.register("device_tpu_prefetch", 8,
+                 "stage-in this many queued tasks ahead of dispatch "
+                 "(H2D overlaps in-flight compute; 0 disables)")
 
 
 def _copy_nbytes(copy: DataCopy) -> int:
@@ -228,7 +231,31 @@ class TPUDevice(Device):
                 batch = self._take_batch_locked()
             if _params.get("device_tpu_batch"):
                 self._flood_from_scheduler(batch)
+            self._prefetch_upcoming()
             self._run_batch(batch)
+
+    def _prefetch_upcoming(self) -> None:
+        """Issue stage-in for queued tasks beyond the current batch: the
+        ``device_put`` enqueues are asynchronous, so these H2D transfers
+        overlap whatever dispatches are still executing — the lookahead
+        half of the H2D/exec/D2H pipeline (``device_gpu.c:1928-2078``'s
+        stage-in stream).  Idempotent: ``stage_in`` short-circuits on a
+        current device copy, so the batch's own stage-in pass re-finds
+        the prefetched tiles."""
+        depth = _params.get("device_tpu_prefetch")
+        if depth <= 0:
+            return
+        # under HBM pressure a lookahead would evict tiles the in-flight
+        # batch still needs (thrash: MORE traffic, not less) — prefetch
+        # only while the cache has comfortable headroom
+        with self._lru_lock:
+            if self._mem_bytes > 0.8 * self._mem_budget:
+                return
+        with self._mutex_lock:
+            upcoming = [d for d in list(self._pending)[:depth]
+                        if d.stage_in is None]
+        for dtask in upcoming:
+            self.stage_in(dtask.task)
 
     def _flood_from_scheduler(self, batch: list[TPUDeviceTask]) -> None:
         """Pull additional ready same-class tasks straight from the

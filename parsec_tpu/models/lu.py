@@ -145,10 +145,12 @@ def _gemm_nn_traceable(a, b, c):
 
 def _tpu_body(traceable):
     def body(es: Any, task: Any, device: Any) -> Any:
+        from ..data.data import ACCESS_WRITE
         flows = [f for f in task.task_class.flows if not f.is_ctl]
         vals = [task.data[f.flow_index].value for f in flows]
         out = traceable(*vals)
-        rw = flows[-1]    # every LU class writes its LAST data flow
+        # write by access mode, matching _run_vmapped's written-flow rule
+        rw = [f for f in flows if f.access & ACCESS_WRITE][-1]
         c = task.data[rw.flow_index]
         c.value = out
         c.version += 1

@@ -178,3 +178,31 @@ class TestVmapBatching:
     def test_batching_off_uses_per_task_path(self, accel_device):
         batched = self._run(accel_device, False)
         assert batched == 0
+
+
+def test_prefetch_is_idempotent(accel_device):
+    """Prefetched stage-in must not double-transfer: bytes_in with the
+    lookahead enabled equals a run with it disabled (same tiles, same
+    numerics)."""
+    from parsec_tpu.core.params import params
+
+    results = {}
+    for depth in (0, 8):
+        old = params.get("device_tpu_prefetch")
+        params.set("device_tpu_prefetch", depth)
+        try:
+            rng = np.random.default_rng(9)
+            a, b, c, A, B, C = _mk_abc(64, 64, 64, 16, rng)
+            bytes_before = accel_device.bytes_in
+            tp = tiled_gemm_ptg(A, B, C, devices="tpu")
+            ctx = Context(nb_cores=0)
+            ctx.add_taskpool(tp)
+            ctx.wait(timeout=120)
+            accel_device.sync()
+            accel_device.flush_cache()
+            ctx.fini()
+            results[depth] = accel_device.bytes_in - bytes_before
+            np.testing.assert_allclose(C.to_dense(), c + a @ b, rtol=1e-3)
+        finally:
+            params.set("device_tpu_prefetch", old)
+    assert results[0] == results[8], results
