@@ -254,6 +254,8 @@ def main() -> None:
     dyn = bench_dynamic_gemm_gflops()
     chol = bench_dynamic_cholesky_gflops()
     lchol = bench_lowered_cholesky_gflops()
+    from parsec_tpu.models.stencil import run_stencil_bench
+    stencil = run_stencil_bench()   # the testing_stencil_1D.c harness
     target = 0.70 * gemm["peak_gflops"]
     print(json.dumps({
         "metric": "ptg_tiled_gemm_gflops_per_chip",
@@ -272,6 +274,7 @@ def main() -> None:
             "dynamic_gemm_batched": dyn.get("batched_dispatches", 0),
             "dynamic_cholesky_gflops": round(chol.get("gflops", 0.0), 1),
             "lowered_cholesky_gflops": round(lchol.get("gflops", 0.0), 1),
+            "stencil_gflops": round(stencil.get("gflops", 0.0), 2),
         },
     }))
 
