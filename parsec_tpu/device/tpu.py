@@ -109,6 +109,16 @@ class TPUDevice(Device):
         # bounded in-flight window (poor-man's event ring)
         self._inflight: deque[Any] = deque()
         self._max_inflight = _params.get("device_tpu_max_inflight")
+        # deferred evictions (the w2r-task analog): victims leave the LRU
+        # immediately but write back AFTER the batch's dispatches enqueue,
+        # so D2H never blocks the manager mid-pipeline.  _evict_bytes
+        # tracks their still-live buffers: residency may exceed the budget
+        # by one batch's eviction volume until the drain (the budget is
+        # advisory — XLA owns physical HBM), and the prefetch guard reads
+        # the SUM so lookahead can't pile onto undrained victims.
+        self._evict_q: deque[DataCopy] = deque()
+        self._evict_bytes = 0
+        self.deferred_evictions = 0
         # vmapped-dispatch cache (dyld name -> jitted vmap of the traceable)
         self._vmap_cache: dict[str, Callable] = {}
         self.batched_dispatches = 0   # XLA calls that serviced >1 task
@@ -138,17 +148,37 @@ class TPUDevice(Device):
                 self._evict_one_locked()
 
     def _evict_one_locked(self) -> None:
-        """Evict the least-recently-used unpinned tile (w2r task analog,
-        ``parsec_gpu_create_w2r_task``)."""
+        """Evict the least-recently-used unpinned tile.  The victim only
+        leaves the LRU here; its write-back is DEFERRED to the w2r queue
+        (``parsec_gpu_create_w2r_task``) drained between batches — the
+        manager never blocks on a D2H mid-pipeline."""
         for k in list(self._mem_lru):
             c = self._mem_lru[k]
             if c.readers > 0:
                 continue
             del self._mem_lru[k]
-            self._mem_bytes -= _copy_nbytes(c)
-            self._writeback(c)
+            nb = _copy_nbytes(c)
+            self._mem_bytes -= nb
+            self._evict_bytes += nb
+            self._evict_q.append(c)
             return
         # nothing evictable; let XLA's allocator cope
+
+    def _drain_evictions(self) -> None:
+        """Write back queued eviction victims (the w2r stage).  A victim
+        that was re-staged meanwhile is back in the LRU under its key —
+        skip it, its residency continues (and is counted there again)."""
+        while True:
+            with self._lru_lock:
+                if not self._evict_q:
+                    return
+                c = self._evict_q.popleft()
+                self._evict_bytes -= _copy_nbytes(c)
+                if self._mem_lru.get(c.original.key) is c:
+                    continue    # resurrected by a later stage_in
+            if c.coherency != COHERENCY_INVALID:
+                self._writeback(c)
+                self.deferred_evictions += 1
 
     def _writeback(self, copy: DataCopy) -> None:
         """Push a dirty device copy back to the host copy, then drop it."""
@@ -171,6 +201,7 @@ class TPUDevice(Device):
     def flush_cache(self) -> None:
         """Synchronize every dirty tile back to its host copy (epilog for a
         taskpool; the data_flush analog for device residency)."""
+        self._drain_evictions()   # pending w2r victims are not in the LRU
         with self._lru_lock:
             for k in list(self._mem_lru):
                 self._writeback(self._mem_lru.pop(k))
@@ -193,9 +224,9 @@ class TPUDevice(Device):
             if dev_copy is not None and dev_copy.version >= copy.version \
                     and dev_copy.coherency != COHERENCY_INVALID:
                 task.data[f.flow_index] = dev_copy
-                with self._lru_lock:
-                    if d.key in self._mem_lru:
-                        self._mem_lru.move_to_end(d.key)
+                # re-insert resurrects an evicted-but-not-yet-written-back
+                # victim: the pending w2r skips anything back in the LRU
+                self._cache_insert(d.key, dev_copy, _copy_nbytes(dev_copy))
                 continue
             # H2D (or D2D: device_put moves from wherever the buffer lives)
             value = jax.device_put(copy.value, self.jax_device)
@@ -223,16 +254,25 @@ class TPUDevice(Device):
                 return HOOK_RETURN_ASYNC  # a manager is already in charge
             self._managing = True
         # we are the manager
-        while True:
+        try:
+            while True:
+                with self._mutex_lock:
+                    if not self._pending:
+                        self._managing = False
+                        return HOOK_RETURN_ASYNC
+                    batch = self._take_batch_locked()
+                if _params.get("device_tpu_batch"):
+                    self._flood_from_scheduler(batch)
+                self._prefetch_upcoming()
+                self._run_batch(batch)
+                self._drain_evictions()   # w2r: D2H after the dispatches
+        except BaseException:
+            # a failed dispatch must not strand the managership: release
+            # it so pending tasks get a (possibly demoted) manager, and
+            # let the error surface through the worker-error path
             with self._mutex_lock:
-                if not self._pending:
-                    self._managing = False
-                    return HOOK_RETURN_ASYNC
-                batch = self._take_batch_locked()
-            if _params.get("device_tpu_batch"):
-                self._flood_from_scheduler(batch)
-            self._prefetch_upcoming()
-            self._run_batch(batch)
+                self._managing = False
+            raise
 
     def _prefetch_upcoming(self) -> None:
         """Issue stage-in for queued tasks beyond the current batch: the
@@ -249,7 +289,7 @@ class TPUDevice(Device):
         # batch still needs (thrash: MORE traffic, not less) — prefetch
         # only while the cache has comfortable headroom
         with self._lru_lock:
-            if self._mem_bytes > 0.8 * self._mem_budget:
+            if self._mem_bytes + self._evict_bytes > 0.8 * self._mem_budget:
                 return
         with self._mutex_lock:
             upcoming = [d for d in list(self._pending)[:depth]

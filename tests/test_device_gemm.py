@@ -206,3 +206,21 @@ def test_prefetch_is_idempotent(accel_device):
         finally:
             params.set("device_tpu_prefetch", old)
     assert results[0] == results[8], results
+
+
+def test_deferred_eviction_under_pressure(accel_device):
+    """A tiny HBM budget forces evictions; victims write back through the
+    deferred w2r queue between batches, and numerics survive."""
+    accel_device._mem_budget = 3 * 16 * 16 * 4   # room for ~3 tiles
+    rng = np.random.default_rng(11)
+    a, b, c, A, B, C = _mk_abc(64, 64, 64, 16, rng)
+    tp = tiled_gemm_ptg(A, B, C, devices="tpu")
+    ctx = Context(nb_cores=0)
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=120)
+    accel_device.sync()
+    accel_device.flush_cache()
+    ctx.fini()
+    np.testing.assert_allclose(C.to_dense(), c + a @ b, rtol=1e-3)
+    assert accel_device.deferred_evictions > 0
+    assert not accel_device._evict_q
