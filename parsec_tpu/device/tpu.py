@@ -261,18 +261,79 @@ class TPUDevice(Device):
                         self._managing = False
                         return HOOK_RETURN_ASYNC
                     batch = self._take_batch_locked()
-                if _params.get("device_tpu_batch"):
-                    self._flood_from_scheduler(batch)
-                self._prefetch_upcoming()
-                self._run_batch(batch)
-                self._drain_evictions()   # w2r: D2H after the dispatches
+                try:
+                    if _params.get("device_tpu_batch"):
+                        self._flood_from_scheduler(batch)
+                    self._prefetch_upcoming()
+                    self._run_batch(batch)
+                    self._drain_evictions()   # w2r: D2H post-dispatch
+                except Exception as e:
+                    # device failure: demote (the PARSEC_HOOK_RETURN_DISABLE
+                    # path) — salvage resident tiles, reschedule the
+                    # un-completed tasks so remaining incarnations run them
+                    self._recover_failed_batch(batch, e)
         except BaseException:
-            # a failed dispatch must not strand the managership: release
-            # it so pending tasks get a (possibly demoted) manager, and
-            # let the error surface through the worker-error path
+            # unrecoverable (salvage escalation, interrupts): release the
+            # managership so the error path never strands queued tasks
             with self._mutex_lock:
                 self._managing = False
             raise
+
+    def _recover_failed_batch(self, batch: list[TPUDeviceTask],
+                              exc: Exception) -> None:
+        """Demote after a failed dispatch: disable this device, salvage
+        device-resident tiles back to their host copies, and reschedule
+        every un-completed task — with the device chore disabled,
+        ``execute_task`` walks on to the remaining incarnations (the
+        ``device_gpu.c:2647-2652`` demote-and-requeue protocol).
+
+        Escalates (re-raises) when a tile newer than its host copy cannot
+        be written back — re-execution would silently read stale inputs,
+        and fail-stop beats wrong answers.
+        """
+        from ..core.output import warning
+        from ..runtime.scheduling import schedule_tasks
+        self.enabled = False
+        warning(f"device {self.name}: dispatch failed ({exc!r}); demoting "
+                f"to remaining incarnations")
+        with self._mutex_lock:
+            victims = [d for d in self._pending]
+            self._pending.clear()
+        victims = [d for d in batch if d.task.status != "done"] + victims
+        with self._lru_lock:
+            copies = list(self._mem_lru.values()) + list(self._evict_q)
+            self._mem_lru.clear()
+            self._evict_q.clear()
+            self._mem_bytes = 0
+            self._evict_bytes = 0
+        # tiles the victims will recompute anyway may be dropped freely;
+        # any OTHER tile newer than its host copy must salvage or we stop
+        from ..data.data import ACCESS_WRITE
+        recomputed: set[int] = set()
+        for d in victims:
+            for f in d.task.task_class.flows:
+                if f.is_ctl or not (f.access & ACCESS_WRITE):
+                    continue
+                cp = d.task.data[f.flow_index]
+                if cp is not None:
+                    recomputed.add(id(cp.original))
+        for c in copies:
+            try:
+                self._writeback(c)
+            except Exception:
+                home = c.original.get_copy(0)
+                newer = home is None or c.version > home.version
+                c.coherency = COHERENCY_INVALID
+                c.original.detach_copy(self.device_index)
+                if newer and id(c.original) not in recomputed:
+                    raise RuntimeError(
+                        f"device {self.name}: tile {c.original.key} newer "
+                        f"than its host copy could not be salvaged — "
+                        f"failing stop rather than recomputing on stale "
+                        f"inputs") from exc
+        for d in victims:
+            d.task.status = "ready"
+            schedule_tasks(d.es, [d.task], 0)
 
     def _prefetch_upcoming(self) -> None:
         """Issue stage-in for queued tasks beyond the current batch: the

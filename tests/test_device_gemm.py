@@ -224,3 +224,47 @@ def test_deferred_eviction_under_pressure(accel_device):
     np.testing.assert_allclose(C.to_dense(), c + a @ b, rtol=1e-3)
     assert accel_device.deferred_evictions > 0
     assert not accel_device._evict_q
+
+
+def test_failed_dispatch_demotes_to_cpu(accel_device):
+    """A device body that raises must not strand the run: the manager
+    salvages resident tiles, disables the device, and the rescheduled
+    tasks demote to their CPU incarnation (device_gpu.c:2647 protocol)."""
+    from parsec_tpu import ptg
+    from parsec_tpu.data.data import TileType
+    from parsec_tpu.data_dist.collection import DictCollection
+
+    coll = DictCollection("F", dtt=TileType((4,), np.float32),
+                          init_fn=lambda *k: np.zeros(4, np.float32))
+    ran = {"cpu": 0, "dev": 0}
+
+    p = ptg.PTGBuilder("demote", F=coll, N=3)
+    t = p.task("T", i=ptg.span(0, lambda g, l: g.N - 1))
+    f = t.flow("V", ptg.RW)
+    f.input(data=("F", lambda g, l: (l.i,)))
+    f.output(data=("F", lambda g, l: (l.i,)))
+
+    def dev_body(es, task, device):
+        ran["dev"] += 1
+        raise RuntimeError("injected device failure")
+
+    from parsec_tpu.device.kernels import register_kernel
+    register_kernel("demote_fail", "tpu", dev_body)
+    t.body(device="tpu", dyld="demote_fail")
+
+    def cpu_body(es, task, g, l):
+        ran["cpu"] += 1
+        v = task.flow_data("V")
+        v.value = np.asarray(v.value) + 7
+
+    t.body(cpu_body)
+
+    ctx = Context(nb_cores=0)
+    ctx.add_taskpool(p.build())
+    ctx.wait(timeout=60)
+    ctx.fini()
+    assert ran["dev"] >= 1              # the device was tried...
+    assert ran["cpu"] == 3              # ...and every task demoted to CPU
+    assert accel_device.enabled is False
+    for i in range(3):
+        assert float(coll.data_of(i).newest_copy().value[0]) == 7.0
