@@ -14,16 +14,20 @@ Rebuild of the reference's communication stack (SURVEY §2.6, §3.4, §5.8):
   termination-detection pending-action discipline.
 - :mod:`multirank` — N-rank harness: one runtime context per rank over a
   shared fabric (the test-facing analog of ``mpiexec -np N``).
+- :mod:`socket_fabric` / :mod:`multiproc` — the multi-PROCESS tier: ranks
+  as separate interpreters over TCP (``run_multiproc``, the true mpiexec
+  analog; set ``PARSEC_TPU_HOSTS`` for multi-host).
 """
 
 from .engine import (AM_TAG_ACTIVATE, AM_TAG_GET_ACK, AM_TAG_TERMDET,
                      CommEngine, InprocFabric, MemHandle)
 from .remote_dep import RemoteDepEngine, RemoteDeps
 from .multirank import run_multirank
+from .multiproc import run_multiproc
 from .termdet_fourcounter import FourCounterTermDet  # registers the component
 
 __all__ = [
     "CommEngine", "InprocFabric", "MemHandle", "RemoteDepEngine",
-    "RemoteDeps", "FourCounterTermDet", "run_multirank", "AM_TAG_ACTIVATE",
+    "RemoteDeps", "FourCounterTermDet", "run_multirank", "run_multiproc", "AM_TAG_ACTIVATE",
     "AM_TAG_GET_ACK", "AM_TAG_TERMDET",
 ]

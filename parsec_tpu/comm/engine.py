@@ -27,6 +27,10 @@ Backends:
   transport: each rank owns one JAX device, ``mem_register`` pins payloads
   device-resident, ``get`` is a device-to-device ``jax.device_put`` (ICI DMA
   on hardware), AMs stay host-side; see §5.8 of SURVEY.md for the mapping.
+- :class:`~parsec_tpu.comm.socket_fabric.SocketCommEngine` over
+  :class:`~parsec_tpu.comm.socket_fabric.SocketFabric` — ranks as separate
+  OS processes over TCP (the DCN tier; launched by
+  :func:`parsec_tpu.comm.multiproc.run_multiproc`, the mpiexec analog).
 """
 
 from __future__ import annotations
