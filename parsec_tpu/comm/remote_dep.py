@@ -217,7 +217,9 @@ class RemoteDepEngine:
         self.ce.fini()
 
     def progress(self, es: Any = None) -> int:
-        return self.flush_outgoing() + self.ce.progress()
+        # the engine's progress drives flush_outgoing through flush_hook,
+        # so one call covers both halves (no double drain)
+        return self.ce.progress()
 
     # -------------------------------------------- outgoing stage (coalescing)
     def _post_activate(self, dst: int, msg: dict) -> None:

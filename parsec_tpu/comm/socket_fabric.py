@@ -41,7 +41,7 @@ _LEN = struct.Struct("<Q")
 
 def _hosts(nranks: int) -> list[str]:
     spec = os.environ.get("PARSEC_TPU_HOSTS", "")
-    hosts = [h for h in spec.split(",") if h.strip()]
+    hosts = [h.strip() for h in spec.split(",") if h.strip()]
     if not hosts:
         hosts = ["127.0.0.1"]
     return [hosts[r % len(hosts)] for r in range(nranks)]

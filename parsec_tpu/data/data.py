@@ -142,3 +142,12 @@ def data_create(value: Any, device_index: int = 0, key: Any = None,
         d.attach_copy(c)
         d.owner_device = device_index
     return d
+
+
+def scratch_copy(dtt: TileType) -> DataCopy:
+    """A fresh zeroed tile of the declared type — THE scratch allocation
+    policy, shared by ``prepare_input`` (WRITE-only/NEW flows) and the
+    compiled-DAG path so the two incarnations can never diverge."""
+    import numpy as np
+    d = data_create(np.zeros(dtt.shape, dtype=dtt.dtype), dtt=dtt)
+    return d.get_copy(0)

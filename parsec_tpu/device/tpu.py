@@ -306,13 +306,16 @@ class TPUDevice(Device):
             self._evict_q.clear()
             self._mem_bytes = 0
             self._evict_bytes = 0
-        # tiles the victims will recompute anyway may be dropped freely;
-        # any OTHER tile newer than its host copy must salvage or we stop
-        from ..data.data import ACCESS_WRITE
+        # tiles the victims will recompute from scratch (WRITE-only flows)
+        # may be dropped freely; an RW flow's prior value is an INPUT, so
+        # it gets no exemption — and any other tile newer than its host
+        # copy must salvage or we stop
+        from ..data.data import ACCESS_READ, ACCESS_WRITE
         recomputed: set[int] = set()
         for d in victims:
             for f in d.task.task_class.flows:
-                if f.is_ctl or not (f.access & ACCESS_WRITE):
+                if f.is_ctl or not (f.access & ACCESS_WRITE) \
+                        or (f.access & ACCESS_READ):
                     continue
                 cp = d.task.data[f.flow_index]
                 if cp is not None:
@@ -332,8 +335,15 @@ class TPUDevice(Device):
                         f"failing stop rather than recomputing on stale "
                         f"inputs") from exc
         for d in victims:
-            d.task.status = "ready"
-            schedule_tasks(d.es, [d.task], 0)
+            # rebind flow slots off this device: the retry must read the
+            # SALVAGED host copies, not dead-device arrays
+            t = d.task
+            for f in t.task_class.flows:
+                cp = None if f.is_ctl else t.data[f.flow_index]
+                if cp is not None and cp.device_index == self.device_index:
+                    t.data[f.flow_index] = cp.original.get_copy(0)
+            t.status = "ready"
+            schedule_tasks(d.es, [t], 0)
 
     def _prefetch_upcoming(self) -> None:
         """Issue stage-in for queued tasks beyond the current batch: the

@@ -279,6 +279,11 @@ class Context:
         es.owner_ident = threading.get_ident()
         backoff = Backoff()
         while not predicate():
+            if self._worker_error is not None:
+                # a dedicated comm thread records failures here too; the
+                # caller-driven loop must surface them, not spin to timeout
+                raise RuntimeError(
+                    "a background thread failed") from self._worker_error
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError("context wait timed out")
             task, distance = select_task(es)

@@ -219,9 +219,8 @@ class CompiledDag(_CompiledDagBase):
 
 
 def _scratch(dtt) -> Any:
-    from ..data.data import data_create
-    d = data_create(np.zeros(dtt.shape, dtype=dtt.dtype), dtt=dtt)
-    return d.get_copy(0)
+    from ..data.data import scratch_copy
+    return scratch_copy(dtt)    # same allocation policy as prepare_input
 
 
 class VecCompiledDag(_CompiledDagBase):
@@ -606,6 +605,11 @@ def _build(tp, builders) -> CompiledDag | None:
                     dc, key = act[0].data_ref(loc)
                     copy = dc.data_of(*key).newest_copy()
                     if copy is None:
+                        raise _Ineligible
+                    if copy.device_index != 0:
+                        # a device copy newer than home means accelerator
+                        # state is in play; enqueue-time binding would
+                        # freeze it — run such pools dynamically
                         raise _Ineligible
                     t.data[f.flow_index] = copy
             gid += 1

@@ -208,12 +208,8 @@ def prepare_input(es: ExecutionStream, task: Task) -> None:
             continue   # explicit NULL arrow: no data for these locals
         if f.dtt is not None:
             # WRITE-only / NEW flow: allocate scratch of the declared type
-            import numpy as np
-
-            from ..data.data import data_create
-            scratch = data_create(np.zeros(f.dtt.shape, dtype=f.dtt.dtype),
-                                  dtt=f.dtt)
-            task.data[f.flow_index] = scratch.get_copy(0)
+            from ..data.data import scratch_copy
+            task.data[f.flow_index] = scratch_copy(f.dtt)
     if _params.get("debug_paranoid"):
         for f in tc.flows:
             if f.is_ctl or not (f.deps_in or f.dtt):
@@ -350,13 +346,23 @@ def apply_writeback_to_home(dc, key: tuple, out_copy,
     if owner is not None and _params.get("debug_paranoid"):
         with _wb_lock:
             mark = getattr(home, "wb_mark", None)
-            if (mark is not None and mark[0] == owner
-                    and out_copy.version <= mark[1]):
-                raise AssertionError(
-                    f"paranoid: unordered writebacks to {dc.name}{key} — "
-                    f"source version {out_copy.version} after {mark[1]} "
-                    f"was already applied (two writers race one home "
-                    f"tile; order them with a flow edge)")
+            if mark is not None and mark[0] == owner:
+                if out_copy.version < mark[1]:
+                    # a strictly older source after a newer one can only
+                    # be an unordered interleave
+                    raise AssertionError(
+                        f"paranoid: unordered writebacks to {dc.name}{key}"
+                        f" — source version {out_copy.version} after "
+                        f"{mark[1]} was already applied (two writers race "
+                        f"one home tile; order them with a flow edge)")
+                if out_copy.version == mark[1]:
+                    # ambiguous: two fresh copies at the same version may
+                    # be CTL-ordered (legal) or racing — warn, don't kill
+                    from ..core.output import show_help
+                    show_help("paranoid", "equal-version-writeback",
+                              f"{dc.name}{key}: two writebacks with equal "
+                              f"source version {out_copy.version}; if the "
+                              f"writers are not CTL-ordered this is a race")
             home.wb_mark = (owner, out_copy.version)
     home.value = out_copy.value
     home.version = max(home.version, out_copy.version) + 1

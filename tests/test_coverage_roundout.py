@@ -243,10 +243,27 @@ class TestParanoid:
         c1 = data_create(np.ones(1, np.float32), key="a").get_copy(0)
         c2 = data_create(np.ones(1, np.float32), key="b").get_copy(0)
         c1.version = 3
-        c2.version = 3   # unordered: same source version
+        c2.version = 2   # strictly older after newer: must be a race
         apply_writeback_to_home(coll, (0,), c1, owner=7)
         with pytest.raises(AssertionError, match="unordered writebacks"):
             apply_writeback_to_home(coll, (0,), c2, owner=7)
+
+    def test_equal_version_writebacks_warn_not_raise(self, param):
+        """Two fresh copies at the same version may be legally CTL-ordered:
+        the paranoid mode warns instead of rejecting a legal program."""
+        from parsec_tpu.core.output import show_help_flush
+        from parsec_tpu.data.data import data_create
+        from parsec_tpu.runtime.scheduling import apply_writeback_to_home
+        param("debug_paranoid", True)
+        coll = DictCollection("R", dtt=TileType((1,), np.float32),
+                              init_fn=lambda *k: np.zeros(1, np.float32))
+        show_help_flush()
+        c1 = data_create(np.ones(1, np.float32), key="e1").get_copy(0)
+        c2 = data_create(np.ones(1, np.float32), key="e2").get_copy(0)
+        apply_writeback_to_home(coll, (0,), c1, owner=8)
+        apply_writeback_to_home(coll, (0,), c2, owner=8)   # no raise
+        counts = show_help_flush()
+        assert counts.get(("paranoid", "equal-version-writeback"), 0) >= 1
 
     def test_ordered_writebacks_pass(self, param):
         from parsec_tpu.runtime.scheduling import apply_writeback_to_home
