@@ -1,0 +1,74 @@
+"""Stress: the round's features composed (the tests/runtime/stress analog).
+
+Each configuration runs a full block-cyclic GEMM through the dynamic
+multi-rank runtime with a different combination of worker threads, the
+dedicated comm thread, coalescing, and scheduler modules — the goal is
+racing the protocol layers against each other, not numerics novelty.
+"""
+
+import numpy as np
+import pytest
+
+import parsec_tpu.runtime.dagrun  # noqa: F401  (registers runtime_dag_compile)
+from parsec_tpu.comm import run_multirank
+from parsec_tpu.core.params import params
+from parsec_tpu.data_dist.matrix import TwoDimBlockCyclic
+from parsec_tpu.models.tiled_gemm import tiled_gemm_ptg
+
+
+@pytest.fixture
+def param():
+    saved = {}
+
+    def set_(name, value):
+        saved[name] = params.get(name)
+        params.set(name, value)
+
+    yield set_
+    for name, value in saved.items():
+        params.set(name, value)
+
+
+def _gemm_body(ctx, rank, nranks):
+    n, nb = 96, 16
+    rng = np.random.RandomState(41)
+    a = rng.randn(n, n).astype(np.float32)
+    b = rng.randn(n, n).astype(np.float32)
+    P = 2 if nranks % 2 == 0 else 1
+    Q = nranks // P
+    A = TwoDimBlockCyclic.from_dense("A", a, nb, nb, P=P, Q=Q, myrank=rank)
+    B = TwoDimBlockCyclic.from_dense("B", b, nb, nb, P=P, Q=Q, myrank=rank)
+    C = TwoDimBlockCyclic("C", n, n, nb, nb, P=P, Q=Q, myrank=rank)
+    ctx.add_taskpool(tiled_gemm_ptg(A, B, C, devices="cpu"))
+    ctx.wait(timeout=180)
+    ctx.comm_barrier()
+    return C.to_dense()
+
+
+def _check(res):
+    n = 96
+    rng = np.random.RandomState(41)
+    a = rng.randn(n, n).astype(np.float32)
+    b = rng.randn(n, n).astype(np.float32)
+    got = np.zeros((n, n), np.float32)
+    for part in res:
+        got += part
+    np.testing.assert_allclose(got, a @ b, rtol=1e-3, atol=1e-3)
+
+
+CONFIGS = [
+    # (nranks, nb_cores, comm_thread, coalesce, sched)
+    (8, 0, False, True, "lfq"),      # wide mesh, funneled
+    (4, 2, True, True, "lfq"),       # workers + comm thread + coalescing
+    (4, 2, True, False, "ll"),       # comm thread, no coalescing, LIFO zoo
+    (2, 3, False, True, "pbq"),      # hierarchical scheduler under workers
+]
+
+
+@pytest.mark.parametrize("nranks,cores,cthread,coal,sched", CONFIGS)
+def test_gemm_stress(param, nranks, cores, cthread, coal, sched):
+    param("comm_thread", cthread)
+    param("comm_coalesce", coal)
+    param("sched", sched)
+    param("runtime_dag_compile", False)   # exercise the dynamic scheduler
+    _check(run_multirank(nranks, _gemm_body, nb_cores=cores, timeout=240))
