@@ -20,6 +20,45 @@ TPU-first design (not a port):
 See SURVEY.md at the repo root for the reference's full structural analysis.
 """
 
+from typing import TYPE_CHECKING
+
 from .version import __version__
 
-__all__ = ["__version__"]
+if TYPE_CHECKING:   # static tooling resolves the lazy names at zero cost
+    from .comm import run_multirank, run_multiproc
+    from .data.checkpoint import restore_collections, save_collections
+    from .dtd import DTDTaskpool
+    from .ptg import PTGBuilder, lower_taskpool, span
+    from .runtime import Context
+
+# Lazy top-level API: the common entry points resolve on first touch so
+# `import parsec_tpu` stays light (no jax import until a runtime object
+# is actually constructed).
+_API = {
+    "Context": ("parsec_tpu.runtime", "Context"),
+    "PTGBuilder": ("parsec_tpu.ptg", "PTGBuilder"),
+    "span": ("parsec_tpu.ptg", "span"),
+    "lower_taskpool": ("parsec_tpu.ptg", "lower_taskpool"),
+    "DTDTaskpool": ("parsec_tpu.dtd", "DTDTaskpool"),
+    "run_multirank": ("parsec_tpu.comm", "run_multirank"),
+    "run_multiproc": ("parsec_tpu.comm", "run_multiproc"),
+    "save_collections": ("parsec_tpu.data.checkpoint", "save_collections"),
+    "restore_collections": ("parsec_tpu.data.checkpoint",
+                            "restore_collections"),
+}
+
+__all__ = ["__version__", *_API]
+
+
+def __getattr__(name):
+    target = _API.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    value = getattr(importlib.import_module(target[0]), target[1])
+    globals()[name] = value    # cache: resolve once
+    return value
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(__all__)))

@@ -179,3 +179,15 @@ def test_backoff_grows_and_resets():
     assert b._cur_ns == 40
     b.reset()
     assert b._cur_ns == 0
+
+
+def test_top_level_api_lazy_exports():
+    """`from parsec_tpu import Context, ...` works, resolved lazily."""
+    import parsec_tpu
+    for name in ("Context", "PTGBuilder", "span", "lower_taskpool",
+                 "DTDTaskpool", "run_multirank", "run_multiproc",
+                 "save_collections", "restore_collections"):
+        assert getattr(parsec_tpu, name) is not None
+    assert "Context" in dir(parsec_tpu)
+    with pytest.raises(AttributeError):
+        parsec_tpu.no_such_symbol
