@@ -10,7 +10,7 @@ distributions (block-cyclic etc.) live in :mod:`parsec_tpu.data_dist.matrix`.
 from __future__ import annotations
 
 import threading
-from typing import Any
+from typing import Any, Iterable
 
 import numpy as np
 
@@ -43,15 +43,22 @@ class DataCollection:
 class DictCollection(DataCollection):
     """Host-dict-backed collection for tests and small apps: every key owned
     by ``rank_of_fn`` (default rank 0), data created lazily from
-    ``init_fn(key)`` or zeros of ``dtt``."""
+    ``init_fn(key)`` or zeros of ``dtt``.
+
+    ``keys`` optionally *declares* the key space up front (still lazily
+    materialized) — consumers that must walk the whole collection (the
+    taskpool→XLA lowering, operators) then see the declared space rather
+    than only what has been touched so far."""
 
     def __init__(self, name: str = "dict", dtt: TileType | None = None,
                  init_fn: Any = None, nodes: int = 1, myrank: int = 0,
-                 rank_of_fn: Any = None) -> None:
+                 rank_of_fn: Any = None,
+                 keys: Iterable[tuple] | None = None) -> None:
         super().__init__(name, nodes, myrank)
         self.default_dtt = dtt
         self._init_fn = init_fn
         self._rank_of_fn = rank_of_fn
+        self._keys = None if keys is None else list(keys)
         self._store: dict[tuple, Data] = {}
         self._lock = threading.Lock()
 
@@ -81,8 +88,10 @@ class DictCollection(DataCollection):
             return key in self._store
 
     def known_keys(self) -> list[tuple]:
-        """Keys materialized so far (a DictCollection has no a-priori key
-        space; operators enumerate what exists)."""
+        """The declared key space if one was given, else the keys
+        materialized so far (operators enumerate what exists)."""
+        if self._keys is not None:
+            return list(self._keys)
         with self._lock:
             return sorted(self._store)
 

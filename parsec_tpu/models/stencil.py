@@ -47,7 +47,9 @@ def stencil_1d_ptg(V: VectorTwoDimCyclic, weights: np.ndarray,
         init_fn=lambda i: np.array(
             np.asarray(V.data_of(i).newest_copy().value)),
         nodes=V.nodes, myrank=V.myrank,
-        rank_of_fn=lambda i: V.rank_of(i))
+        rank_of_fn=lambda i: V.rank_of(i),
+        keys=[(i,) for i in range(V.mt)])   # declared key space: mirrors
+    # V's 1-D tiling, so the taskpool→XLA lowering can walk the snapshot
 
     p = ptg.PTGBuilder("stencil1d", V=V, V0=V0, NT=NT, T=iterations,
                        W=np.asarray(weights, dtype=np.float64), R=R)
@@ -102,8 +104,38 @@ def stencil_1d_ptg(V: VectorTwoDimCyclic, weights: np.ndarray,
         task.set_flow_data(
             "C", data_create(new, key=("st", l.t, l.i)).get_copy(0))
 
-    t.body(body)
-    return p.build()
+    # Traceable incarnation for the compiled (wavefront) lowering: weights
+    # fold into the program as constants; boundary tasks arrive with their
+    # L/R flow as None (no active arrow) and read zero ghosts, exactly like
+    # the dynamic body.  Computes in the promoted tile dtype (f64 tiles stay
+    # f64 when ``jax_enable_x64`` is on; TPU-native runs are f32).  Scoped to
+    # THIS taskpool via ``local_traceables`` — weights differ per build, so
+    # the process-global registry is not the right home.
+    Wd = np.asarray(weights, np.float64)
+    R_ = R
+
+    def traceable(c, left, right):
+        import jax.numpy as jnp
+        dt = c.dtype
+        ct = jnp.result_type(dt, jnp.float32)
+        cw = c.astype(ct)
+        lg = (jnp.zeros((R_,), ct) if left is None
+              else left[-R_:].astype(ct))
+        rg = (jnp.zeros((R_,), ct) if right is None
+              else right[:R_].astype(ct))
+        padded = jnp.concatenate([lg, cw, rg])
+        n = cw.shape[0]
+        w = np.asarray(Wd, ct)
+        out = jnp.zeros_like(cw)
+        for j in range(2 * R_ + 1):
+            out = out + w[j] * padded[j:j + n]
+        return out.astype(dt)
+
+    from ..ptg.lowering import Traceable
+    t.body(body, dyld="stencil1d")
+    tp = p.build()
+    tp.local_traceables = {"stencil1d": Traceable(traceable)}
+    return tp
 
 
 def stencil_reference(x: np.ndarray, weights: np.ndarray,

@@ -25,7 +25,16 @@ Pipeline:
    (``out = acc + lhs·rhs`` on tiles) collapses into one batched contraction
    over the tile stores — the k-chain of GEMM(m,n,k) becomes a single
    ``einsum('mkab,knbc->mnac')`` that XLA tiles onto the MXU at full size.
-4. **Unrolled dataflow fallback** — any other regular DAG is traced task by
+4. **Wavefront-batch pass** — the general MXU-saturation pass (the compiled
+   analog of the device module's vmapped batching, and of the reference GPU
+   hook keeping a stream full across a whole panel, ``jdf2c.c:6566``,
+   ``device_gpu.c:2522-2531``): every flow value is resolved to a *store
+   row* (tile dataflow is tile versioning), tasks are grouped per
+   (topological wavefront, class, source signature), and each group becomes
+   ONE ``jax.vmap``-batched kernel call over rows gathered from the stores —
+   O(wavefronts·classes) program size instead of O(tasks), and the trailing
+   update of a whole Cholesky panel lands on the MXU as one batched matmul.
+5. **Unrolled dataflow fallback** — any other regular DAG is traced task by
    task in topological order inside one jit; XLA fuses from there.
 
 Kernels participate by registering a *traceable incarnation* — a pure
@@ -138,21 +147,24 @@ class _ClassInfo:
                                if f.access in (ACCESS_RW, ACCESS_WRITE)]
 
 
-def _class_kernel(tc) -> Traceable | None:
+def _class_kernel(tc, local: dict | None = None) -> Traceable | None:
     for chore in tc.chores:
         if chore.dyld is not None:
-            t = find_traceable(chore.dyld)
+            t = (local or {}).get(chore.dyld) or find_traceable(chore.dyld)
             if t is not None:
                 return t
     return None
 
 
 def _analyze(tp) -> dict[str, _ClassInfo]:
+    # taskpools may carry build-scoped traceables (per-instance constants
+    # like stencil weights) without touching the process-global registry
+    local = getattr(tp, "local_traceables", None)
     infos: dict[str, _ClassInfo] = {}
     for tc in tp.task_classes:
         tcb = tp._tc_builders[tc.name]
         tasks = list(tcb._enumerate_space())
-        kernel = _class_kernel(tc)
+        kernel = _class_kernel(tc, local)
         if kernel is None and any(not f.is_ctl for f in tc.flows):
             raise LoweringError(
                 f"task class {tc.name} has data flows but no traceable "
@@ -507,11 +519,297 @@ def _try_chain_collapse(tp, infos, stores: _Stores):
 
 
 # ---------------------------------------------------------------------------
-# pass 2: generic unrolled dataflow (topological trace)
+# pass 2: wavefront batching (one vmapped kernel call per (level, class))
 # ---------------------------------------------------------------------------
 
-def _topo_order(tp, infos) -> list[tuple[str, int]]:
-    """Kahn's ordering over the concrete task DAG (CTL edges count)."""
+def _build_wavefront(tp, infos, stores: _Stores):
+    """Group independent same-class tasks per topological wavefront and emit
+    ONE batched kernel call per (wavefront, class, source-signature) group.
+
+    The key resolution step: *every data-flow value lives in a store row*.
+    A task's input either names a collection tile directly (``data=``) or a
+    predecessor's flow value — and that value, recursively, is an updated
+    *version* of some tile (tiled dataflow is tile versioning).  Writable
+    flows therefore update their home row **in place** inside the jit-local
+    stores; successors gather from the same rows.  Versions are tracked
+    statically and any interleaving where in-place reuse would clobber a
+    still-needed version raises :class:`LoweringError` (→ unrolled pass).
+
+    Within one wavefront all tasks are independent (levels are longest-path:
+    every dep edge strictly crosses levels), so each level executes as
+    *gather-all → compute groups → scatter-all* — snapshot semantics that
+    make the level's result independent of group ordering.  The emitted
+    program is O(levels·classes) XLA ops; a whole Cholesky trailing update
+    becomes one ``vmap``-batched tile matmul on the MXU (the compiled analog
+    of the reference keeping a GPU stream saturated across a panel,
+    ``jdf2c.c:6566``, ``device_gpu.c:2522-2531``).
+    """
+    order, levels = _task_graph(tp, infos)
+
+    # ---- value/version resolution ------------------------------------------
+    # value_of[(cname, key, flow_index)] = (store_name, row, version)
+    #   version: ("init", L)    — row content as of the start of level L
+    #            ("task", n, L) — written by node n at level L
+    value_of: dict[tuple, tuple] = {}
+    # writes[row] = [(level, node, is_scratch)] — is_scratch marks in-place
+    # version storage (never a collection write in the source program)
+    writes: dict[tuple[str, int], list[tuple[int, tuple, bool]]] = {}
+    data_last: dict[tuple[str, int], int] = {}      # last collection write
+    scratch_last: dict[tuple[str, int], int] = {}   # last in-place write
+    reads: list[tuple[tuple[str, int], tuple, int]] = []
+
+    plans = []
+    for node in order:
+        cname, i = node
+        info = infos[cname]
+        if not info.data_flows:
+            continue                      # CTL-only class: shapes levels only
+        tc, loc = info.tc, info.tasks[i]
+        key = tc.make_key(loc)
+        L = levels[node]
+        in_plan: list[tuple] = []   # ("row", name, row) | ("none",) per flow
+        in_vers: list[tuple | None] = []          # version read, per flow
+        for f in info.data_flows:
+            deps = _active_in_deps(f, loc)
+            if len(deps) > 1:
+                raise LoweringError(
+                    f"{cname}{key} flow {f.name}: {len(deps)} active input "
+                    f"deps — ambiguous source")
+            if not deps:
+                in_plan.append(("none",))
+                in_vers.append(None)
+                continue
+            d = deps[0]
+            if d.data_ref is not None:
+                dc, k = d.data_ref(loc)
+                row = (dc.name, stores.row(dc, _norm_key(k)))
+                ver = ("init", L)
+            else:
+                ptc = tp.task_class(d.target_class)
+                pkey = ptc.make_key(d.target_params(loc))
+                pfi = next(ff.flow_index for ff in ptc.flows
+                           if ff.name == d.target_flow)
+                try:
+                    pname, prow, ver = value_of[(d.target_class, pkey, pfi)]
+                except KeyError:
+                    raise LoweringError(
+                        f"{cname}{key} flow {f.name}: predecessor value "
+                        f"{d.target_class}{pkey}.{d.target_flow} has no "
+                        f"store-resident home")
+                row = (pname, prow)
+            reads.append((row, ver, L))
+            in_plan.append(("row",) + row)
+            in_vers.append(ver)
+        writable_ids = {id(f) for f in info.writable_flows}
+        out_plan = []               # (primary|None, extras, writable) per flow
+        for fj, f in enumerate(info.data_flows):
+            drows = []
+            for d in _active_out_deps(f, loc):
+                if d.data_ref is not None:
+                    dc, k = d.data_ref(loc)
+                    drows.append((dc.name, stores.row(dc, _norm_key(k))))
+                    stores.written.add(dc.name)
+            if id(f) in writable_ids:
+                if drows:
+                    primary, extras = drows[0], drows[1:]
+                    data_last[primary] = max(data_last.get(primary, -1), L)
+                    writes.setdefault(primary, []).append((L, node, False))
+                else:
+                    ip = in_plan[fj]
+                    if ip[0] != "row":
+                        raise LoweringError(
+                            f"{cname}{key} flow {f.name}: writable flow with "
+                            f"neither a collection target nor a "
+                            f"store-resident input — no home row")
+                    primary, extras = (ip[1], ip[2]), []
+                    scratch_last[primary] = max(
+                        scratch_last.get(primary, -1), L)
+                    writes.setdefault(primary, []).append((L, node, True))
+                value_of[(cname, key, f.flow_index)] = (
+                    primary[0], primary[1], ("task", node, L))
+                for w in extras:
+                    writes.setdefault(w, []).append((L, node, False))
+                    data_last[w] = max(data_last.get(w, -1), L)
+                out_plan.append((primary, extras, True))
+            else:
+                ip = in_plan[fj]
+                if ip[0] == "row":
+                    # pass-through: successors read the same row/version
+                    value_of[(cname, key, f.flow_index)] = (
+                        ip[1], ip[2], in_vers[fj])
+                elif drows:
+                    raise LoweringError(
+                        f"{cname}{key} flow {f.name}: collection write from "
+                        f"a flow with no input value")
+                for w in drows:
+                    writes.setdefault(w, []).append((L, node, False))
+                    data_last[w] = max(data_last.get(w, -1), L)
+                out_plan.append((None, drows, False))
+        plans.append((node, L, cname, in_plan, out_plan))
+
+    # ---- static hazard checks (violations → unrolled fallback) -------------
+    for w, ws in writes.items():
+        seen_levels = set()
+        for lw, _, _ in ws:
+            if lw in seen_levels:
+                raise LoweringError(
+                    f"store row {w}: two writers in one wavefront")
+            seen_levels.add(lw)
+    for row, ver, L in reads:
+        if ver[0] == "task":
+            # version must survive from its creation to this read: no other
+            # write may land strictly between (snapshot semantics make
+            # same-level writes safe)
+            lo = ver[2]
+            for lw, _, _ in writes.get(row, ()):
+                if lo < lw < L:
+                    raise LoweringError(
+                        f"store row {row}: version created at level {lo} "
+                        f"overwritten at {lw} before its read at {L}")
+        else:
+            # collection read snapshotted at level Ls (== the reader's level
+            # for direct reads; earlier for pass-through forwarding).  The
+            # snapshot must survive until gathered at L, and an in-place
+            # *scratch* version parked on the row before Ls must never be
+            # visible — the source program still sees the pristine tile
+            # there (earlier collection writes ARE visible: the unrolled /
+            # dynamic ordering semantics).
+            Ls = ver[1]
+            for lw, _, scratch in writes.get(row, ()):
+                if Ls <= lw < L:
+                    raise LoweringError(
+                        f"store row {row}: snapshot taken at level {Ls} "
+                        f"overwritten at {lw} before its read at {L}")
+                if scratch and lw < Ls:
+                    raise LoweringError(
+                        f"store row {row}: scratch version written at level "
+                        f"{lw} would be visible to the collection read at "
+                        f"{Ls}")
+    dirty: list[tuple[str, int]] = []
+    for w, sl in scratch_last.items():
+        dl = data_last.get(w, -1)
+        if dl < 0:
+            dirty.append(w)         # scratch-only row: restore at the end
+        elif sl > dl:
+            raise LoweringError(
+                f"store row {w}: in-place write at level {sl} after the "
+                f"final collection write at {dl}")
+    dirty_by_name: dict[str, np.ndarray] = {}
+    for name, grp in itertools.groupby(sorted(dirty), key=lambda w: w[0]):
+        dirty_by_name[name] = np.array([r for _, r in grp], np.int32)
+
+    # ---- grouping ----------------------------------------------------------
+    by_level: dict[int, dict[tuple, list]] = {}
+    for node, L, cname, in_plan, out_plan in plans:
+        sig = (cname,
+               tuple(ip[0] if ip[0] == "none" else ("row", ip[1])
+                     for ip in in_plan),
+               tuple((p[0] if p else None, tuple(n for n, _ in ex), w)
+                     for p, ex, w in out_plan))
+        by_level.setdefault(L, {}).setdefault(sig, []).append(
+            (in_plan, out_plan))
+
+    level_specs = []
+    for L in sorted(by_level):
+        specs = []
+        for sig, members in by_level[L].items():
+            cname = sig[0]
+            info = infos[cname]
+            G = len(members)
+            gathers = []    # per data flow: None | (name, rows|None, row0)
+            for fj in range(len(info.data_flows)):
+                ip0 = members[0][0][fj]
+                if ip0[0] == "none":
+                    gathers.append(None)
+                    continue
+                rows = np.array([m[0][fj][2] for m in members], np.int32)
+                if (rows == rows[0]).all():
+                    gathers.append((ip0[1], None, int(rows[0])))
+                else:
+                    gathers.append((ip0[1], rows, None))
+            wi = {f.flow_index: j for j, f in enumerate(info.writable_flows)}
+            scatters = []   # (name, rows array, src_kind, src_idx)
+            for fj, f in enumerate(info.data_flows):
+                _, _, writable = members[0][1][fj]
+                if writable:
+                    n_tgt = 1 + len(members[0][1][fj][1])
+                    for t in range(n_tgt):
+                        rows = np.array(
+                            [(m[1][fj][0] if t == 0 else m[1][fj][1][t - 1])[1]
+                             for m in members], np.int32)
+                        name = (members[0][1][fj][0] if t == 0
+                                else members[0][1][fj][1][t - 1])[0]
+                        scatters.append((name, rows, "out", wi[f.flow_index]))
+                else:
+                    for t in range(len(members[0][1][fj][1])):
+                        rows = np.array([m[1][fj][1][t][1] for m in members],
+                                        np.int32)
+                        name = members[0][1][fj][1][t][0]
+                        scatters.append((name, rows, "in", fj))
+            specs.append((info.kernel.apply, gathers, scatters, G))
+        level_specs.append(specs)
+
+    # ---- emission ----------------------------------------------------------
+    def step_fn(st: dict) -> dict:
+        import jax
+        import jax.numpy as jnp
+        st = dict(st)
+        saved = {name: st[name][rows]
+                 for name, rows in dirty_by_name.items()}
+        for specs in level_specs:
+            pend = []                        # scatters applied level-atomic
+            for apply, gathers, scatters, G in specs:
+                args, axes = [], []
+                for gth in gathers:
+                    if gth is None:
+                        args.append(None)
+                        axes.append(None)
+                    elif gth[1] is None:
+                        args.append(st[gth[0]][gth[2]])
+                        axes.append(None)
+                    else:
+                        args.append(st[gth[0]][gth[1]])
+                        axes.append(0)
+                if G == 1 or all(ax is None for ax in axes):
+                    res = apply(*args)
+                    res = res if isinstance(res, tuple) else (res,)
+                    out_batched = False
+                else:
+                    def tup_apply(*a):
+                        r = apply(*a)
+                        return r if isinstance(r, tuple) else (r,)
+                    res = jax.vmap(tup_apply, in_axes=tuple(axes))(*args)
+                    out_batched = True
+                for name, rows, src_kind, src_idx in scatters:
+                    if src_kind == "out":
+                        v, batched = res[src_idx], out_batched
+                    else:
+                        v, batched = args[src_idx], axes[src_idx] == 0
+                    pend.append((name, rows, v, batched))
+            for name, rows, v, batched in pend:
+                if batched:
+                    st[name] = st[name].at[rows].set(v)
+                elif len(rows) == 1:
+                    st[name] = st[name].at[int(rows[0])].set(v)
+                else:
+                    st[name] = st[name].at[rows].set(
+                        jnp.broadcast_to(v, (len(rows),) + v.shape))
+        for name, rows in dirty_by_name.items():
+            st[name] = st[name].at[rows].set(saved[name])
+        return st
+
+    return step_fn
+
+
+# ---------------------------------------------------------------------------
+# pass 3: generic unrolled dataflow (topological trace)
+# ---------------------------------------------------------------------------
+
+def _task_graph(tp, infos):
+    """Concrete task DAG (CTL edges count): returns ``(order, levels)`` —
+    a Kahn topological order over ``(cname, i)`` nodes and each node's
+    *wavefront level* (longest path from a source; an edge always crosses
+    levels strictly, so same-level tasks are mutually independent)."""
     index: dict[tuple[str, tuple], tuple[str, int]] = {}
     for cname, info in infos.items():
         for i, loc in enumerate(info.tasks):
@@ -535,17 +833,23 @@ def _topo_order(tp, infos) -> list[tuple[str, int]]:
                         succs[(cname, i)].append(tgt)
                         indeg[tgt] += 1
     ready = [v for v, n in indeg.items() if n == 0]
+    levels = {v: 0 for v in ready}
     out = []
     while ready:
         v = ready.pop()
         out.append(v)
         for s in succs[v]:
+            levels[s] = max(levels.get(s, 0), levels[v] + 1)
             indeg[s] -= 1
             if indeg[s] == 0:
                 ready.append(s)
     if len(out) != len(indeg):
         raise LoweringError("task graph has a cycle")
-    return out
+    return out, levels
+
+
+def _topo_order(tp, infos) -> list[tuple[str, int]]:
+    return _task_graph(tp, infos)[0]
 
 
 def _build_unrolled(tp, infos, stores: _Stores):
@@ -641,7 +945,7 @@ class LoweredTaskpool:
         self.taskpool = tp
         self.step_fn = step_fn
         self._stores = stores
-        self.mode = mode    # "chain-collapse" | "unrolled"
+        self.mode = mode    # "chain-collapse" | "wavefront" | "unrolled"
         self.mesh = mesh    # jax Mesh with a "ranks" axis, or None
         self._jitted = None
 
@@ -678,14 +982,18 @@ class LoweredTaskpool:
         return out
 
 
-def lower_taskpool(tp, context: Any = None,
-                   mesh: Any = None) -> LoweredTaskpool:
+def lower_taskpool(tp, context: Any = None, mesh: Any = None,
+                   passes: str = "auto") -> LoweredTaskpool:
     """Lower a regular PTG taskpool to one XLA program.
 
     ``mesh``: a :class:`jax.sharding.Mesh` with one ``"ranks"`` axis — lowers
     the *distributed* taskpool to a single SPMD program over that mesh, tile
     ownership taken from each collection's ``rank_of`` (the distribution the
     dynamic runtime would route remote deps by).
+
+    ``passes``: ``"auto"`` tries chain-collapse → wavefront → unrolled (most
+    specialized first); or force one of ``"chain-collapse"``, ``"wavefront"``,
+    ``"unrolled"`` (testing / benchmarking individual emissions).
 
     Raises :class:`LoweringError` when the structure is not lowerable; the
     caller then runs the dynamic scheduler instead (same taskpool object).
@@ -703,11 +1011,25 @@ def lower_taskpool(tp, context: Any = None,
                             "(see lower_taskpool docstring); dynamic path "
                             "here")
     infos = _analyze(tp)
-    stores = _Stores(nranks)
-    step = _try_chain_collapse(tp, infos, stores)
-    mode = "chain-collapse"
-    if step is None:
+    if passes not in ("auto", "chain-collapse", "wavefront", "unrolled"):
+        raise ValueError(f"unknown lowering pass {passes!r}")
+
+    if passes in ("auto", "chain-collapse"):
         stores = _Stores(nranks)
-        step = _build_unrolled(tp, infos, stores)
-        mode = "unrolled"
-    return LoweredTaskpool(tp, step, stores, mode, mesh=mesh)
+        step = _try_chain_collapse(tp, infos, stores)
+        if step is not None:
+            return LoweredTaskpool(tp, step, stores, "chain-collapse",
+                                   mesh=mesh)
+        if passes == "chain-collapse":
+            raise LoweringError("taskpool does not chain-collapse")
+    if passes in ("auto", "wavefront"):
+        stores = _Stores(nranks)
+        try:
+            step = _build_wavefront(tp, infos, stores)
+            return LoweredTaskpool(tp, step, stores, "wavefront", mesh=mesh)
+        except LoweringError:
+            if passes == "wavefront":
+                raise
+    stores = _Stores(nranks)
+    step = _build_unrolled(tp, infos, stores)
+    return LoweredTaskpool(tp, step, stores, "unrolled", mesh=mesh)

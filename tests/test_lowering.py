@@ -117,13 +117,8 @@ def test_gemm_permuted_operand_uses_stacked_gather():
 register_traceable("lower_scale2", lambda x: x * 2.0)
 
 
-def test_unrolled_chain_with_pred_edges():
-    """A non-bilinear accumulation chain goes through the unrolled pass:
-    value forwarding across pred edges, final store writeback only."""
-    n, nb, K = 8, 4, 3
-    x = np.arange(n * n, dtype=np.float32).reshape(n, n)
+def _scale_chain_ptg(x, nb=4, K=3):
     X = TiledMatrix.from_dense("X", x.copy(), nb, nb)
-
     p = ptg.PTGBuilder("chain", X=X, K=K, MT=X.mt, NT=X.nt)
     t = p.task("SCALE",
                m=ptg.span(0, lambda g, l: g.MT - 1),
@@ -140,9 +135,27 @@ def test_unrolled_chain_with_pred_edges():
     f.output(data=("X", lambda g, l: (l.m, l.n)),
              guard=lambda g, l: l.k == g.K - 1)
     t.body(device="tpu", dyld="lower_scale2")
+    return p.build(), X
 
-    low = lower_taskpool(p.build())
+
+def test_unrolled_chain_with_pred_edges():
+    """A non-bilinear accumulation chain through the forced unrolled pass:
+    value forwarding across pred edges, final store writeback only."""
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    tp, X = _scale_chain_ptg(x)
+    low = lower_taskpool(tp, passes="unrolled")
     assert low.mode == "unrolled"
+    low.execute()
+    np.testing.assert_allclose(X.to_dense(), x * 8.0)
+
+
+def test_wavefront_chain_auto_selected_and_matches():
+    """auto picks the wavefront pass for a non-bilinear chain; per-level
+    batched emission computes the same result as unrolled."""
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    tp, X = _scale_chain_ptg(x)
+    low = lower_taskpool(tp)
+    assert low.mode == "wavefront"
     low.execute()
     np.testing.assert_allclose(X.to_dense(), x * 8.0)
 
@@ -168,11 +181,153 @@ def test_read_flow_forwarding_through_two_classes():
     t2.body(device="tpu", dyld="lower_scale2")
 
     low = lower_taskpool(p.build())
-    assert low.mode == "unrolled"
+    assert low.mode == "wavefront"
     low.execute()
     # SRC's READ flow forwards X unchanged (its result is not a writable
     # flow); DST doubles it once.
     np.testing.assert_allclose(Y.to_dense(), x * 2.0)
+
+
+def test_wavefront_program_is_level_sized_not_task_sized():
+    """The wavefront emission is O(levels·classes): for a K-step chain over
+    many tiles its jaxpr is a small multiple of K, far below the unrolled
+    pass's O(tasks) trace (the round-3 perf ceiling on Cholesky/stencil)."""
+    import jax
+
+    x = np.zeros((32, 32), np.float32)
+    tp, X = _scale_chain_ptg(x, nb=4, K=3)        # 64 tasks per level
+    wf = lower_taskpool(tp, passes="wavefront")
+    un = lower_taskpool(tp, passes="unrolled")
+    n_wf = len(jax.make_jaxpr(wf.step_fn)(wf.initial_stores()).eqns)
+    n_un = len(jax.make_jaxpr(un.step_fn)(un.initial_stores()).eqns)
+    assert n_wf < n_un / 5, (n_wf, n_un)
+    assert n_wf < 40, n_wf                        # ~a handful of ops per level
+
+
+def test_wavefront_war_hazard_falls_back_to_unrolled():
+    """A version that must survive past a later in-place write cannot run
+    through in-place wavefront stores — auto degrades to unrolled and the
+    forwarded value is still the ORIGINAL tile."""
+    x = np.full((4, 4), 3.0, np.float32)
+    X = TiledMatrix.from_dense("X", x, 4, 4)
+    Y = TiledMatrix.from_dense("Y", np.zeros((4, 8), np.float32), 4, 4)
+
+    p = ptg.PTGBuilder("war", X=X, Y=Y)
+    # SRC reads X(0,0) and forwards it two levels down to DST
+    t1 = p.task("SRC", z=ptg.span(0, 0))
+    f1 = t1.flow("A", ptg.READ)
+    f1.input(data=("X", lambda g, l: (0, 0)))
+    f1.output(succ=("MID", "B", lambda g, l: {"z": 0}))
+    t1.body(device="tpu", dyld="lower_scale2")
+    t2 = p.task("MID", z=ptg.span(0, 0))
+    f2 = t2.flow("B", ptg.READ)
+    f2.input(pred=("SRC", "A", lambda g, l: {"z": 0}))
+    f2.output(succ=("DST", "C", lambda g, l: {"z": 0}))
+    t2.body(device="tpu", dyld="lower_scale2")
+    t3 = p.task("DST", z=ptg.span(0, 0))
+    f3 = t3.flow("C", ptg.RW)
+    f3.input(pred=("MID", "B", lambda g, l: {"z": 0}))
+    f3.output(data=("Y", lambda g, l: (0, 0)))
+    t3.body(device="tpu", dyld="lower_scale2")
+    # WRITER updates X(0,0) in place (no collection out-arrow: a scratch
+    # write in wavefront terms), racing the forwarded original
+    t4 = p.task("WRITER", z=ptg.span(0, 0))
+    f4 = t4.flow("V", ptg.RW)
+    f4.input(data=("X", lambda g, l: (0, 0)))
+    f4.output(succ=("SINK", "W", lambda g, l: {"z": 0}))
+    t4.body(device="tpu", dyld="lower_scale2")
+    t5 = p.task("SINK", z=ptg.span(0, 0))
+    f5 = t5.flow("W", ptg.RW)
+    f5.input(pred=("WRITER", "V", lambda g, l: {"z": 0}))
+    f5.output(data=("Y", lambda g, l: (0, 1)))
+    t5.body(device="tpu", dyld="lower_scale2")
+
+    low = lower_taskpool(p.build())
+    assert low.mode == "unrolled"     # wavefront detected the WAR hazard
+    low.execute()
+    d = Y.to_dense()
+    np.testing.assert_allclose(d[:4, :4], x * 2.0)       # original forwarded
+    np.testing.assert_allclose(d[:4, 4:8], x * 4.0)      # WRITER·2 then SINK·2
+
+
+def test_wavefront_scratch_never_shadows_collection_read():
+    """An in-place (scratch) version parked on a store row must not be
+    visible to a LATER direct ``data=`` read of that row — the source
+    program still sees the pristine tile.  The wavefront pass detects the
+    shadowing and auto falls back to unrolled."""
+    x = np.full((4, 8), 3.0, np.float32)
+    X = TiledMatrix.from_dense("X", x, 4, 4)      # tiles (0,0), (0,1)
+    Y = TiledMatrix.from_dense("Y", np.zeros((4, 4), np.float32), 4, 4)
+
+    p = ptg.PTGBuilder("shadow", X=X, Y=Y)
+    # WRITER doubles X(0,0) in place (succ-only out-arrow: scratch write)
+    t1 = p.task("WRITER", z=ptg.span(0, 0))
+    f1 = t1.flow("V", ptg.RW)
+    f1.input(data=("X", lambda g, l: (0, 0)))
+    f1.output(succ=("SINK", "W", lambda g, l: {"z": 0}))
+    t1.body(device="tpu", dyld="lower_scale2")
+    t2 = p.task("SINK", z=ptg.span(0, 0))
+    f2 = t2.flow("W", ptg.READ)
+    f2.input(pred=("WRITER", "V", lambda g, l: {"z": 0}))
+    t2.body(device="tpu", dyld="lower_scale2")
+    # PRE pushes READER to level 1 via a CTL edge; READER then reads X(0,0)
+    # directly — AFTER the scratch write has landed on its row
+    t3 = p.task("PRE", z=ptg.span(0, 0))
+    f3 = t3.flow("P", ptg.READ)
+    f3.input(data=("X", lambda g, l: (0, 1)))
+    c3 = t3.flow("GO", ptg.CTL)
+    c3.output(succ=("READER", "D", lambda g, l: {"z": 0}))
+    t3.body(device="tpu", dyld="lower_scale2")
+    t4 = p.task("READER", z=ptg.span(0, 0))
+    f4 = t4.flow("D", ptg.CTL)
+    f4.input(pred=("PRE", "GO", lambda g, l: {"z": 0}))
+    f5 = t4.flow("E", ptg.READ)
+    f5.input(data=("X", lambda g, l: (0, 0)))
+    f5.output(data=("Y", lambda g, l: (0, 0)))
+    t4.body(device="tpu", dyld="lower_scale2")
+
+    low = lower_taskpool(p.build())
+    assert low.mode == "unrolled"
+    low.execute()
+    np.testing.assert_allclose(Y.to_dense(), x[:, :4])  # pristine, not 2x
+
+
+register_traceable("lower_halo_sum",
+                   lambda c, l, r: c + (0.0 if l is None else l.sum())
+                   + (0.0 if r is None else r.sum()))
+
+
+def test_wavefront_missing_inputs_pass_none():
+    """Flows with no active input arrow (stencil boundaries) reach the
+    traceable as ``None``; boundary tasks group separately from interior."""
+    nb = 2
+    x = np.arange(12, dtype=np.float32).reshape(2, 6)
+    X = TiledMatrix.from_dense("X", x.copy(), 2, nb)
+    NT = X.nt
+
+    p = ptg.PTGBuilder("halo", X=X, NT=NT)
+    t = p.task("H", i=ptg.span(0, lambda g, l: g.NT - 1))
+    fc = t.flow("C", ptg.RW)
+    fc.input(data=("X", lambda g, l: (0, l.i)))
+    fc.output(data=("X", lambda g, l: (0, l.i)))
+    fl = t.flow("L", ptg.READ)
+    fl.input(data=("X", lambda g, l: (0, l.i - 1)),
+             guard=lambda g, l: l.i > 0)
+    fr = t.flow("R", ptg.READ)
+    fr.input(data=("X", lambda g, l: (0, l.i + 1)),
+             guard=lambda g, l: l.i < g.NT - 1)
+    t.body(device="tpu", dyld="lower_halo_sum")
+
+    low = lower_taskpool(p.build())
+    assert low.mode == "wavefront"
+    low.execute()
+    tiles = [x[:, 2 * i:2 * i + 2] for i in range(NT)]
+    expect = np.hstack([
+        tiles[i]
+        + (tiles[i - 1].sum() if i > 0 else 0.0)
+        + (tiles[i + 1].sum() if i < NT - 1 else 0.0)
+        for i in range(NT)])
+    np.testing.assert_allclose(X.to_dense(), expect)
 
 
 def test_python_body_is_not_lowerable():

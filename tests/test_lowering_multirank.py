@@ -102,8 +102,8 @@ def test_lowered_gemm_matches_dynamic_multirank():
 
 
 @pytest.mark.parametrize("nranks", [2, 4])
-def test_lowered_cholesky_unrolled_multirank(nranks):
-    """Four task classes, triangular space, range arrows — the unrolled
+def test_lowered_cholesky_wavefront_multirank(nranks):
+    """Four task classes, triangular space, range arrows — the wavefront
     lowering pass, sharded.  POTRF/TRSM/SYRK/GEMM traceables drive it."""
     n, nb = 64, 16
     spd = make_spd(n)
@@ -111,7 +111,7 @@ def test_lowered_cholesky_unrolled_multirank(nranks):
                                         P=nranks, Q=1)
     tp = tiled_cholesky_ptg(A)
     low = lower_taskpool(tp, mesh=mesh_of(nranks))
-    assert low.mode == "unrolled"
+    assert low.mode == "wavefront"
     low.execute()
     got = np.tril(assemble(A))
     expect = np.linalg.cholesky(spd.astype(np.float64))
@@ -123,7 +123,7 @@ def test_lowered_cholesky_single_rank():
     spd = make_spd(n)
     A = SymTwoDimBlockCyclic.from_dense("A", spd, nb, nb)
     low = lower_taskpool(tiled_cholesky_ptg(A))
-    assert low.mode == "unrolled"
+    assert low.mode == "wavefront"
     low.execute()
     got = np.tril(A.to_dense())
     np.testing.assert_allclose(got, np.linalg.cholesky(spd.astype(np.float64)),

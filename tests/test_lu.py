@@ -1,6 +1,6 @@
 """Tiled LU (nopiv): the second dense factorization, on all three tiers —
 dynamic single-rank, dynamic multi-rank over the comm engine, and the
-unrolled lowering (single-rank and sharded)."""
+compiled lowering (single-rank and sharded)."""
 
 import numpy as np
 import pytest
@@ -68,17 +68,17 @@ class TestDynamic:
 
 
 class TestLowered:
-    def test_unrolled_single(self):
+    def test_lowered_single(self):
         from parsec_tpu.ptg.lowering import lower_taskpool
         n, nb = 64, 16
         a = make_dd(n)
         A = TwoDimBlockCyclic.from_dense("A", a, nb, nb)
         low = lower_taskpool(tiled_lu_ptg(A))
-        assert low.mode == "unrolled"
+        assert low.mode == "wavefront"
         low.execute()
         check_factors(assemble(A), a)
 
-    def test_unrolled_sharded(self):
+    def test_lowered_sharded(self):
         import jax
         from jax.sharding import Mesh
 
