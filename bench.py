@@ -177,32 +177,35 @@ def bench_dynamic_cholesky_gflops(n: int = 8192, nb: int = 1024) -> dict:
     }
 
 
-def bench_lowered_cholesky_gflops(n: int = 8192, nb: int = 1024) -> dict:
+def bench_lowered_cholesky_gflops(n: int = 16384, nb: int = 512) -> dict:
     """The compiled incarnation of the Cholesky PTG: four task classes,
-    triangular space, unrolled by the lowering into ONE XLA program (the
-    per-panel TRSM inverses CSE into a single solve).  For scale: XLA's own
-    jnp.linalg.cholesky runs this size at ~12 GFLOPS on a v5e — the tiled
-    dataflow program is several times faster."""
+    triangular space, batched per topological wavefront by the lowering —
+    every panel's trailing update lands on the MXU as ONE batched tile
+    matmul.  For scale: XLA's own jnp.linalg.cholesky runs n=8192 at ~12
+    GFLOPS on a v5e; the wavefront program measures in the TFLOPS.  Synced
+    by a device-side scalar read (np.asarray(out) would drag the whole
+    factored matrix through the TPU tunnel and time the transfer, which is
+    exactly the round-3 bench bug this replaces)."""
     import jax
     import numpy as np
 
     from parsec_tpu.data_dist.matrix import SymTwoDimBlockCyclic
-    from parsec_tpu.models.cholesky import (cholesky_flops, make_spd,
+    from parsec_tpu.models.cholesky import (cholesky_flops, make_spd_fast,
                                             tiled_cholesky_ptg)
     from parsec_tpu.ptg.lowering import lower_taskpool
 
-    a = make_spd(n)
+    a = make_spd_fast(n)
     A = SymTwoDimBlockCyclic.from_dense("A", a, nb, nb)
     low = lower_taskpool(tiled_cholesky_ptg(A))
     st = {k: jax.device_put(v) for k, v in low.initial_stores().items()}
     jf = jax.jit(low.step_fn)
     out = jf(st)
-    _ = float(np.asarray(out["A"])[0, 0, 0])    # compile + warm
+    _ = float(out["A"].reshape(-1)[0])          # compile + warm
     times = []
     for _i in range(3):
         t0 = time.perf_counter()
         out = jf(st)
-        _ = float(np.asarray(out["A"])[0, 0, 0])
+        _ = float(out["A"].reshape(-1)[0])      # device-side slice sync
         times.append(time.perf_counter() - t0)
     t = statistics.median(times)
     # spot-check the first tile against the dense factorization
@@ -211,6 +214,47 @@ def bench_lowered_cholesky_gflops(n: int = 8192, nb: int = 1024) -> dict:
     err = float(np.max(np.abs(np.tril(got) - expect)))
     return {"gflops": cholesky_flops(n) / t / 1e9, "n": n, "nb": nb,
             "seconds": t, "mode": low.mode, "tile00_abs_err": err}
+
+
+def bench_lowered_stencil_gflops(n: int = 1 << 24, mb: int = 1 << 18,
+                                 radius: int = 4, iterations: int = 64) -> dict:
+    """The compiled incarnation of the 1-D stencil app (halo-exchange tier):
+    T wavefronts, each ONE batched (2R+1)-tap update over all tiles, ghost
+    reads as store gathers.  Memory-bound by design — the number measures
+    how close the emitted program gets to HBM bandwidth."""
+    import jax
+    import numpy as np
+
+    from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
+    from parsec_tpu.models.stencil import (stencil_1d_ptg, stencil_flops,
+                                           stencil_reference)
+    from parsec_tpu.ptg.lowering import lower_taskpool
+
+    rng = np.random.default_rng(0)
+    base = rng.standard_normal(n).astype(np.float32)
+    V = VectorTwoDimCyclic("V", lm=n, mb=mb, P=1,
+                           init_fn=lambda m, size:
+                           base[m * mb:m * mb + size])
+    weights = np.full(2 * radius + 1, 1.0 / (2 * radius + 1))
+    low = lower_taskpool(stencil_1d_ptg(V, weights, iterations))
+    st = {k: jax.device_put(v) for k, v in low.initial_stores().items()}
+    jf = jax.jit(low.step_fn)
+    out = jf(st)
+    _ = float(out["V"].reshape(-1)[0])
+    times = []
+    for _i in range(3):
+        t0 = time.perf_counter()
+        out = jf(st)
+        _ = float(out["V"].reshape(-1)[0])
+        times.append(time.perf_counter() - t0)
+    t = statistics.median(times)
+    # spot-check the first tile against the dense oracle
+    got = np.asarray(out["V"][0])
+    want = stencil_reference(base, weights, iterations)[:mb]
+    err = float(np.max(np.abs(got - want)))
+    return {"gflops": stencil_flops(n, radius, iterations) / t / 1e9,
+            "seconds": t, "n": n, "mb": mb, "radius": radius,
+            "iterations": iterations, "mode": low.mode, "max_abs_err": err}
 
 
 def bench_dispatch_us(ntasks: int = 2000) -> float:
@@ -255,6 +299,7 @@ def main() -> None:
     dispatch_us = bench_dispatch_us()
     from parsec_tpu.models.stencil import run_stencil_bench
     stencil = run_stencil_bench()   # the testing_stencil_1D.c harness
+    lsten = bench_lowered_stencil_gflops()
     lchol = bench_lowered_cholesky_gflops()
     dyn = bench_dynamic_gemm_gflops()
     chol = bench_dynamic_cholesky_gflops()
@@ -277,7 +322,9 @@ def main() -> None:
             "dynamic_gemm_batched": dyn.get("batched_dispatches", 0),
             "dynamic_cholesky_gflops": round(chol.get("gflops", 0.0), 1),
             "lowered_cholesky_gflops": round(lchol.get("gflops", 0.0), 1),
+            "lowered_cholesky_n": lchol.get("n", 0),
             "stencil_gflops": round(stencil.get("gflops", 0.0), 2),
+            "lowered_stencil_gflops": round(lsten.get("gflops", 0.0), 1),
         },
     }))
 

@@ -79,7 +79,8 @@ class DeviceCommEngine(InprocCommEngine):
 
     def mem_register(self, value: Any, refcount: int = 1,
                      on_drained: Callable[[], None] | None = None,
-                     owned: bool = False) -> MemHandle:
+                     owned: bool = False,
+                     peers: set[int] | None = None) -> MemHandle:
         """Pin ``value`` on this rank's device and publish it.
 
         numpy payloads are snapshotted (``device_put`` on the CPU backend
@@ -95,7 +96,8 @@ class DeviceCommEngine(InprocCommEngine):
             value = jax.device_put(value, self.device)
         self.bytes_put += getattr(value, "nbytes", 0)
         # the copy/upload above is the snapshot: ownership is settled
-        return super().mem_register(value, refcount, on_drained, owned=True)
+        return super().mem_register(value, refcount, on_drained, owned=True,
+                                    peers=peers)
 
     def _finish_get(self, eng: Any, src: int, msg: dict) -> None:
         """Land the payload on MY device (the ICI D2D pull)."""

@@ -70,19 +70,25 @@ class MemHandle:
 
     ``refcount`` counts peers still expected to pull; the publisher drops the
     registration when it reaches zero (the ``mem_unregister`` moment).
+    ``peers`` optionally names the consumer ranks — a peer that dies before
+    its GET then releases its reference through
+    :meth:`CommEngine.on_peer_failed` instead of pinning the buffer forever.
     """
 
-    __slots__ = ("handle_id", "rank", "value", "refcount", "on_drained")
+    __slots__ = ("handle_id", "rank", "value", "refcount", "on_drained",
+                 "peers")
 
     _ids = itertools.count(1)
 
     def __init__(self, rank: int, value: Any, refcount: int = 1,
-                 on_drained: Callable[[], None] | None = None) -> None:
+                 on_drained: Callable[[], None] | None = None,
+                 peers: set[int] | None = None) -> None:
         self.handle_id = next(MemHandle._ids)
         self.rank = rank
         self.value = value
         self.refcount = refcount
         self.on_drained = on_drained
+        self.peers = set(peers) if peers is not None else None
 
     def wire(self) -> tuple[int, int]:
         """The on-the-wire form: (owner rank, handle id)."""
@@ -147,7 +153,8 @@ class CommEngine:
     # -- registered memory / one-sided ---------------------------------------
     def mem_register(self, value: Any, refcount: int = 1,
                      on_drained: Callable[[], None] | None = None,
-                     owned: bool = False) -> MemHandle:
+                     owned: bool = False,
+                     peers: set[int] | None = None) -> MemHandle:
         """Publish a buffer for one-sided GETs.
 
         The engine needs a stable snapshot (the last consumer may receive the
@@ -155,10 +162,13 @@ class CommEngine:
         copied here unless the caller asserts ownership with ``owned=True``
         — the invariant lives at the API boundary, not in caller convention.
         Immutable payloads (JAX arrays) alias safely either way.
+
+        ``peers`` names the consumer ranks expected to pull (one reference
+        each); :meth:`on_peer_failed` then releases a dead peer's share.
         """
         if not owned and isinstance(value, np.ndarray):
             value = value.copy()
-        h = MemHandle(self.rank, value, refcount, on_drained)
+        h = MemHandle(self.rank, value, refcount, on_drained, peers=peers)
         with self._mem_lock:
             self._mem[h.handle_id] = h
         return h
@@ -167,18 +177,42 @@ class CommEngine:
         with self._mem_lock:
             return self._mem.get(handle_id)
 
-    def mem_release(self, handle_id: int) -> None:
+    def mem_release(self, handle_id: int, peer: int | None = None) -> None:
         """Drop one reference; unregister when drained."""
         with self._mem_lock:
             h = self._mem.get(handle_id)
             if h is None:
                 return
             h.refcount -= 1
+            if peer is not None and h.peers is not None:
+                h.peers.discard(peer)
             if h.refcount > 0:
                 return
             del self._mem[handle_id]
         if h.on_drained is not None:
             h.on_drained()
+
+    def on_peer_failed(self, rank: int) -> int:
+        """Release every registration share held for a dead peer — the
+        buffer-GC moment the reference performs at communicator teardown
+        (``parsec_mpi_funnelled.c:431``), here per-peer so a failed rank
+        cannot pin its producers' memory forever.  Returns the number of
+        handles fully drained by this."""
+        drained = []
+        with self._mem_lock:
+            for hid in list(self._mem):
+                h = self._mem[hid]
+                if h.peers is None or rank not in h.peers:
+                    continue
+                h.peers.discard(rank)
+                h.refcount -= 1
+                if h.refcount <= 0:
+                    del self._mem[hid]
+                    drained.append(h)
+        for h in drained:
+            if h.on_drained is not None:
+                h.on_drained()
+        return len(drained)
 
     def get(self, rwire: tuple[int, int],
             on_complete: Callable[[Any], None]) -> None:
@@ -203,7 +237,13 @@ class CommEngine:
         raise NotImplementedError
 
     def fini(self) -> None:
-        pass
+        """Teardown: force-drop every live registration (the reference frees
+        registered buffers when the communicator dies)."""
+        with self._mem_lock:
+            leftovers, self._mem = list(self._mem.values()), {}
+        for h in leftovers:
+            if h.on_drained is not None:
+                h.on_drained()
 
 
 class InprocCommEngine(CommEngine):
@@ -214,6 +254,7 @@ class InprocCommEngine(CommEngine):
         self.fabric = fabric
         self._pending_gets: dict[int, Callable] = {}
         self._get_ids = itertools.count(1)
+        self.dup_get_replies = 0
         self._barrier_seen: dict[int, set] = {}
         self._barrier_gen = 0
         self._progress_lock = threading.Lock()
@@ -252,10 +293,17 @@ class InprocCommEngine(CommEngine):
             value = value.copy()
         self.send_am(AM_TAG_GET_REPLY, msg["reply_to"],
                      {"get_id": msg["get_id"], "value": value})
-        self.mem_release(msg["handle"])
+        # the puller's share is consumed: clear it from the expected-peer
+        # set too, so a LATER death of that rank cannot double-release
+        self.mem_release(msg["handle"], peer=msg["reply_to"])
 
     def _finish_get(self, eng: CommEngine, src: int, msg: dict) -> None:
-        cb = self._pending_gets.pop(msg["get_id"])
+        cb = self._pending_gets.pop(msg["get_id"], None)
+        if cb is None:
+            # duplicate reply (e.g. a transport-level replay after a
+            # reconnect): the first landing completed the get — idempotent
+            self.dup_get_replies += 1
+            return
         cb(msg["value"])
 
     # -- progress -------------------------------------------------------------

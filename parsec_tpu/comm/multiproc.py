@@ -54,7 +54,14 @@ def run_multiproc(nranks: int, target: str, timeout: float = 180.0,
                   nb_cores: int = 0) -> list[Any]:
     """Run ``target`` on ``nranks`` subprocess ranks; returns the per-rank
     results.  Retries once on a lost port-range race (a bind collision
-    surfaces as one rank failing, or as a timeout of the survivors)."""
+    surfaces as one rank failing, or as a timeout of the survivors).
+
+    Execution is therefore **at-least-once**: on the retry path every rank
+    body runs again from scratch, so bodies with external side effects
+    (files, network writes) must be idempotent or key their outputs by
+    attempt.  The collision happens while the socket fabric bootstraps —
+    normally before any user code runs — but a partially-connected mesh can
+    have let early ranks start their bodies before the failure surfaced."""
     try:
         return _run_multiproc(nranks, target, timeout, nb_cores)
     except (RuntimeError, TimeoutError) as e:

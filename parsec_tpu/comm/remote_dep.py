@@ -153,12 +153,18 @@ class RemoteDepEngine:
         # remote_dep_mpi.c:1066-1194
         self._outq: dict[int, list] = {}
         self._outq_lock = threading.Lock()
+        # serializes whole drains of the outgoing stage: concurrent callers
+        # (worker via _flush_if_unthreaded, comm thread, engine flush hook)
+        # would otherwise interleave their per-peer sends and break the
+        # highest-priority-first ordering across snapshots
+        self._flush_serial = threading.Lock()
         self._outseq = itertools.count()
         self._comm_thread: threading.Thread | None = None
         self._comm_stop: threading.Event | None = None
         # activation seq -> (taskpool, parent_rank or None)
         self._inflight: dict[int, Any] = {}
         self._iflock = threading.Lock()
+        self.dup_acks = 0      # duplicate/unknown acks tolerated (faults)
         # activations/DTD messages whose taskpool comm-id is not registered
         # yet (cf. DEP_NEW_TASKPOOL delays, remote_dep_mpi.c); guarded by a
         # lock: appended from worker progress, replayed from the enqueuing
@@ -240,20 +246,23 @@ class RemoteDepEngine:
     def flush_outgoing(self) -> int:
         """Drain the outgoing stage: one AM per peer, messages inside
         ordered highest-priority-first (the same-peer aggregation +
-        priority ordering of remote_dep_mpi.c:1066-1194)."""
+        priority ordering of remote_dep_mpi.c:1066-1194).  Whole drains are
+        serialized so the priority contract holds globally, not merely
+        per-snapshot, when multiple progress paths flush at once."""
         if not self._outq:
             return 0
-        with self._outq_lock:
-            batches, self._outq = self._outq, {}
-        n = 0
-        for dst, items in batches.items():
-            items.sort(key=lambda it: it[:2])
-            msgs = [m for _, _, m in items]
-            if len(msgs) == 1:
-                self.ce.send_am(AM_TAG_ACTIVATE, dst, msgs[0])
-            else:
-                self.ce.send_am(AM_TAG_ACTIVATE, dst, {"batch": msgs})
-            n += len(msgs)
+        with self._flush_serial:
+            with self._outq_lock:
+                batches, self._outq = self._outq, {}
+            n = 0
+            for dst, items in batches.items():
+                items.sort(key=lambda it: it[:2])
+                msgs = [m for _, _, m in items]
+                if len(msgs) == 1:
+                    self.ce.send_am(AM_TAG_ACTIVATE, dst, msgs[0])
+                else:
+                    self.ce.send_am(AM_TAG_ACTIVATE, dst, {"batch": msgs})
+                n += len(msgs)
         return n
 
     def inflight(self) -> int:
@@ -321,16 +330,20 @@ class RemoteDepEngine:
                                           if isinstance(value, np.ndarray)
                                           else value)
                     else:
-                        nchildren = len(tree_children(
-                            _params.get("comm_bcast_tree"), 0,
-                            len(ranks) + 1))
+                        all_ranks = [self.my_rank] + ranks
+                        child_ranks = [
+                            all_ranks[p] for p in tree_children(
+                                _params.get("comm_bcast_tree"), 0,
+                                len(all_ranks))]
                         # snapshot at registration: a local successor may
                         # mutate the live host tile in place before the
                         # remote GET is served (the reference retains a
                         # refcounted data copy for the whole send); the
-                        # engine copies mutable buffers at the boundary
+                        # engine copies mutable buffers at the boundary.
+                        # peers= lets a dead child's share be reclaimed.
                         h = self.ce.mem_register(value,
-                                                 refcount=nchildren)
+                                                 refcount=len(child_ranks),
+                                                 peers=set(child_ranks))
                         desc["wire"] = h.wire()
                         desc["shape"] = value.shape
                         desc["dtype"] = str(value.dtype)
@@ -366,7 +379,13 @@ class RemoteDepEngine:
 
     def _on_ack(self, eng, src: int, msg: dict) -> None:
         with self._iflock:
-            tp = self._inflight.pop(msg["seq"])
+            tp = self._inflight.pop(msg["seq"], None)
+        if tp is None:
+            # duplicate or unknown ack (transport replay after a reconnect,
+            # or a peer acking twice): the first landing already settled the
+            # pending-action count — tolerate, count, move on
+            self.dup_acks += 1
+            return
         tp.tdm.taskpool_addto_nb_pa(-1)
 
     # ------------------------------------------------- consumer (receiver) side
@@ -553,7 +572,9 @@ class RemoteDepEngine:
                     # place (the engine copies mutable buffers; device
                     # arrays are immutable and alias)
                     value = _wire_value(landed[d["flow_index"]])
-                    h = self.ce.mem_register(value, refcount=len(children))
+                    h = self.ce.mem_register(
+                        value, refcount=len(children),
+                        peers={msg["ranks"][p] for p in children})
                     d["wire"] = h.wire()
             self._send_to_children(tp, fwd, my_pos=my_pos)
             self._flush_if_unthreaded()

@@ -8,11 +8,20 @@ TCP, and the entire protocol stack above the engine vtable — remote-dep
 activation, propagation trees, coalescing, termdet waves, DTD pushes —
 runs unchanged (``RemoteDepEngine`` never learns which fabric it rides).
 
-Wire format: length-prefixed pickles of ``(tag, src, payload)`` frames.
-Topology: rank *i* listens on ``base_port + i``; outgoing connections are
-made lazily with connect-retry (peers boot in any order).  The host list
-defaults to localhost (the oversubscribed test form — real multi-host runs
-set ``PARSEC_TPU_HOSTS=h0,h1,...``).
+Wire format: length-prefixed pickles of ``("d", seq, tag, src, payload)``
+data frames and ``("a", src, upto)`` cumulative acks.  Topology: rank *i*
+listens on ``base_port + i``; outgoing connections are made lazily with
+connect-retry (peers boot in any order).  The host list defaults to
+localhost (the oversubscribed test form — real multi-host runs set
+``PARSEC_TPU_HOSTS=h0,h1,...``).
+
+Fault model: TCP gives in-order reliable delivery *per connection*, but a
+broken connection loses whatever was buffered in flight.  Each peer channel
+therefore carries a monotonically increasing ``seq``; the sender keeps every
+unacked frame in a bounded replay window and, when a send fails, reconnects
+and replays the window; the receiver acks cumulatively every few frames and
+drops duplicates by sequence — so a connection reset anywhere between two
+ranks is invisible above the fabric (exactly-once, in-order per channel).
 
 Use :func:`parsec_tpu.comm.multiproc.run_multiproc` to launch N subprocess
 ranks and collect their results — the ``mpiexec -np N`` analog.
@@ -35,6 +44,19 @@ from .engine import InprocCommEngine
 _params.register("comm_socket_base_port", 39100,
                  "first TCP port of the socket fabric (rank i listens on "
                  "base+i)")
+_params.register("comm_socket_ack_every", 16,
+                 "receiver sends a cumulative ack after this many frames "
+                 "(bounds the sender's replay window)")
+_params.register("comm_socket_replay_window", 4096,
+                 "max unacked frames retained per peer for reconnect "
+                 "replay; exceeding it is a visible error (a peer that "
+                 "stopped acking)")
+_params.register("comm_socket_fault_p", 0.0,
+                 "fault injection: probability per outgoing frame of "
+                 "breaking the connection first (tests the "
+                 "reconnect-and-replay path; 0 disables)")
+_params.register("comm_socket_fault_seed", 0,
+                 "seed for the fault-injection RNG (per-rank offset added)")
 
 _LEN = struct.Struct("<Q")
 
@@ -75,8 +97,28 @@ class SocketFabric:
         self.hosts = _hosts(nranks)
         self._inbox: deque = deque()
         self._ilock = threading.Lock()
-        self._peers: dict[int, list] = {}   # dst -> [sock|None, send-lock]
+        # dst -> [sock|None, send-lock, next_seq, unacked deque[(seq, bytes)]]
+        self._peers: dict[int, list] = {}
         self._plock = threading.Lock()
+        # receiver-side channel state (guarded by _ilock): highest seq seen
+        # per src (duplicate suppression) and frames since the last ack
+        self._seen: dict[int, int] = {}
+        self._unacked_in: dict[int, int] = {}
+        self.replays = 0          # reconnect-and-replay events (observable)
+        self.dup_frames = 0       # duplicate frames suppressed
+        # fault injection (tests): break the connection before some sends
+        fault_p = float(_params.get("comm_socket_fault_p"))
+        self._fault_p = fault_p
+        if fault_p > 0.0:
+            import random
+            self._fault_rng = random.Random(
+                _params.get("comm_socket_fault_seed") + rank)
+        else:
+            self._fault_rng = None
+        # engine hook: invoked with a rank when it stays unreachable past
+        # the reconnect budget (SocketCommEngine points this at its
+        # registered-buffer GC, CommEngine.on_peer_failed)
+        self.on_peer_dead = None
         self._accepted: list[socket.socket] = []   # inbound conns, for close
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -119,6 +161,7 @@ class SocketFabric:
 
     def _recv_main(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        ack_every = _params.get("comm_socket_ack_every")
         while not self._stop.is_set():
             try:
                 head = _recv_exact(conn, _LEN.size)
@@ -131,8 +174,10 @@ class SocketFabric:
             except OSError:
                 return
             except Exception as e:
-                # a corrupt/unimportable payload must be VISIBLE, not a
-                # silently dead receiver thread with a stalled connection
+                # a corrupt/undecodable frame kills only THIS connection —
+                # visibly.  The peer's replay window re-sends everything it
+                # had in flight on its next send; the seq dedup below keeps
+                # delivery exactly-once across the reset.
                 from ..core.output import warning
                 warning(f"socket fabric rank {self.rank}: dropping "
                         f"connection on undecodable frame: {e!r}")
@@ -141,8 +186,54 @@ class SocketFabric:
                 except OSError:
                     pass
                 return
+            if frame[0] == "a":                  # cumulative ack
+                _, src, upto = frame
+                self._prune_unacked(src, upto)
+                continue
+            _, seq, tag, src, payload = frame
+            ack_now = None
             with self._ilock:
-                self._inbox.append(frame)
+                if seq <= self._seen.get(src, 0):
+                    self.dup_frames += 1         # replay overlap: suppress
+                else:
+                    self._seen[src] = seq
+                    self._inbox.append((tag, src, payload))
+                n = self._unacked_in.get(src, 0) + 1
+                if n >= ack_every:
+                    self._unacked_in[src] = 0
+                    ack_now = self._seen[src]
+                else:
+                    self._unacked_in[src] = n
+            if ack_now is not None:
+                self._send_ack(src, ack_now)
+
+    def _prune_unacked(self, src: int, upto: int) -> None:
+        with self._plock:
+            ent = self._peers.get(src)
+        if ent is None:
+            return
+        with ent[1]:
+            q = ent[3]
+            while q and q[0][0] <= upto:
+                q.popleft()
+
+    def _send_ack(self, src: int, upto: int) -> None:
+        """Best-effort cumulative ack (idempotent: never replayed; a lost
+        ack just leaves the peer's window larger until the next one).
+        Runs on a receive thread, so a missing reverse connection gets only
+        a SHORT connect budget — stalling reception behind a 30s boot retry
+        would freeze frames already queued on this connection."""
+        with self._plock:
+            ent = self._peers.get(src)
+            if ent is None:
+                ent = self._peers[src] = [None, threading.Lock(), 0, deque()]
+        try:
+            with ent[1]:
+                if ent[0] is None:
+                    ent[0] = self._connect(src, retry_s=2.0)
+                ent[0].sendall(_frame(("a", self.rank, upto)))
+        except OSError:
+            pass
 
     # --------------------------------------------------------------- send
     def _peer(self, dst: int) -> tuple[socket.socket | None, threading.Lock]:
@@ -153,33 +244,91 @@ class SocketFabric:
         with self._plock:
             ent = self._peers.get(dst)
             if ent is None:
-                ent = self._peers[dst] = [None, threading.Lock()]
+                ent = self._peers[dst] = [None, threading.Lock(), 0, deque()]
         with ent[1]:
             if ent[0] is None:
-                deadline = time.monotonic() + 30.0
-                while True:
-                    try:
-                        s = socket.create_connection(
-                            (self.hosts[dst], self.base_port + dst),
-                            timeout=2.0)
-                        break
-                    except OSError:
-                        if time.monotonic() > deadline:
-                            raise
-                        time.sleep(0.05)   # peer still booting
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                ent[0] = s
+                ent[0] = self._connect(dst)
         return ent[0], ent[1]
+
+    def _connect(self, dst: int, retry_s: float = 30.0) -> socket.socket:
+        """Connect to ``dst``, retrying refusals for up to ``retry_s`` (30s
+        default covers peers still booting; reconnect/ack paths pass a short
+        budget — a peer dead mid-run should fail fast, not hang callers for
+        the boot window).  Bails immediately on fabric teardown."""
+        deadline = time.monotonic() + retry_s
+        while True:
+            if self._stop.is_set():
+                raise OSError("fabric is shutting down")
+            try:
+                s = socket.create_connection(
+                    (self.hosts[dst], self.base_port + dst), timeout=2.0)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    self._peer_dead(dst)
+                    raise
+                time.sleep(0.05)   # peer still booting
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _peer_dead(self, dst: int) -> None:
+        """A peer is unreachable past its retry budget: tell the engine so
+        it can release resources pinned for that rank (registered-buffer
+        shares via ``CommEngine.on_peer_failed``)."""
+        cb = self.on_peer_dead
+        if cb is not None:
+            try:
+                cb(dst)
+            except Exception:       # a GC hook must never mask the OSError
+                pass
 
     def deliver(self, dst: int, tag: int, src: int, payload: Any) -> None:
         if dst == self.rank:
             with self._ilock:
                 self._inbox.append((tag, src, payload))
             return
-        data = _frame((tag, src, payload))   # pickle OUTSIDE the send lock
-        s, lock = self._peer(dst)
-        with lock:    # frames must not interleave on one connection
-            s.sendall(data)
+        with self._plock:
+            ent = self._peers.get(dst)
+            if ent is None:
+                ent = self._peers[dst] = [None, threading.Lock(), 0, deque()]
+        with ent[1]:     # frames must not interleave on one connection
+            if len(ent[3]) >= _params.get("comm_socket_replay_window"):
+                raise RuntimeError(
+                    f"rank {self.rank}: replay window to rank {dst} full "
+                    f"({len(ent[3])} unacked frames) — peer stopped acking")
+            ent[2] += 1
+            seq = ent[2]
+            data = _frame(("d", seq, tag, src, payload))
+            ent[3].append((seq, data))
+            if ent[0] is None:
+                ent[0] = self._connect(dst)
+            if (self._fault_rng is not None
+                    and self._fault_rng.random() < self._fault_p):
+                # injected fault: hard-break the live connection so this
+                # send fails and exercises reconnect-and-replay
+                try:
+                    ent[0].shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            try:
+                ent[0].sendall(data)
+            except OSError:
+                self._reconnect_and_replay(dst, ent)
+
+    def _reconnect_and_replay(self, dst: int, ent: list) -> None:
+        """A broken connection loses whatever TCP had buffered: reconnect
+        and resend the whole unacked window in order (caller holds the
+        send lock).  The receiver's seq dedup drops the overlap."""
+        try:
+            if ent[0] is not None:
+                ent[0].close()
+        except OSError:
+            pass
+        ent[0] = None
+        self.replays += 1
+        ent[0] = self._connect(dst, retry_s=5.0)
+        for _seq, data in list(ent[3]):
+            ent[0].sendall(data)     # a second failure here is fatal: raise
 
     # ----------------------------------------------------- drain (local)
     def drain(self, rank: int, limit: int = 64) -> list[tuple]:
@@ -241,6 +390,10 @@ class SocketCommEngine(InprocCommEngine):
 
     def __init__(self, fabric: SocketFabric) -> None:
         super().__init__(fabric, fabric.rank)
+        # a rank unreachable past the reconnect budget releases its
+        # registered-buffer shares (the peer-death GC)
+        fabric.on_peer_dead = self.on_peer_failed
 
     def fini(self) -> None:
+        super().fini()          # force-drop leftover registrations first
         self.fabric.close()

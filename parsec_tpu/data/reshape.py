@@ -29,8 +29,14 @@ from typing import Any
 import numpy as np
 
 from ..core.future import DataCopyFuture
+from ..core.params import params as _params
+from ..core.params import register as _register_param
 from .data import DataCopy, data_create
 from .datatype import TileType, convert
+
+_register_param("reshape_timeout_s", 60.0,
+                "Seconds resolve_copy waits for a reshape future before "
+                "declaring the producing thread stalled")
 
 __all__ = ["needs_reshape", "reshaped_future", "resolve_copy", "edge_dtt",
            "reshape_for_edge", "reshape_for_writeback"]
@@ -102,10 +108,13 @@ def reshaped_future(copy: DataCopy, want: TileType) -> DataCopyFuture:
 
 
 def resolve_copy(v: Any) -> Any:
-    """Materialize a reshape future (runs the conversion once, any thread)."""
+    """Materialize a reshape future (runs the conversion once, any thread).
+    The wait bound is the ``reshape_timeout_s`` MCA param — tunable like
+    every other runtime limit (a stalled-but-correct program under load
+    should raise the bound, not hit a hardcoded constant)."""
     if isinstance(v, DataCopyFuture):
         v.trigger()
-        return v.get(timeout=60)
+        return v.get(timeout=_params.get("reshape_timeout_s"))
     return v
 
 

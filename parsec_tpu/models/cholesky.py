@@ -138,6 +138,16 @@ register_kernel("gemm_nt", "tpu", gemm_nt_tpu_body)
 # ---------------------------------------------------------------------------
 
 
+def _mm_precision():
+    """The ``gemm_precision`` policy, shared with the dynamic-path GEMM
+    body: ``highest`` forces full-precision multiplies on TPU, where the
+    default runs f32 tiles through bf16 MXU passes (fast, ~3 decimal
+    digits).  One home for the mapping (``ops/gemm.py``), imported lazily
+    so building a PTG never pulls jax."""
+    from ..ops.gemm import _precision
+    return _precision()
+
+
 def _potrf_traceable(t):
     _, jnp, _ = _jax()
     return jnp.linalg.cholesky(t.astype(jnp.float32))
@@ -153,21 +163,23 @@ def _trsm_traceable(lkk, c):
     lkk = lkk.astype(jnp.float32)
     linv = jsl.solve_triangular(lkk, jnp.eye(lkk.shape[0], dtype=lkk.dtype),
                                 lower=True)
-    return (linv @ c.astype(jnp.float32).T).T
+    return jnp.matmul(linv, c.astype(jnp.float32).T,
+                      precision=_mm_precision()).T
 
 
 def _syrk_traceable(a, t):
     _, jnp, _ = _jax()
     a = a.astype(jnp.float32)
     return t.astype(jnp.float32) - jnp.dot(
-        a, a.T, preferred_element_type=jnp.float32)
+        a, a.T, preferred_element_type=jnp.float32,
+        precision=_mm_precision())
 
 
 def _gemm_nt_traceable(a, b, c):
     _, jnp, _ = _jax()
     return c.astype(jnp.float32) - jnp.dot(
         a.astype(jnp.float32), b.astype(jnp.float32).T,
-        preferred_element_type=jnp.float32)
+        preferred_element_type=jnp.float32, precision=_mm_precision())
 
 
 def _register_traceables() -> None:
@@ -304,3 +316,15 @@ def make_spd(n: int, seed: int = 0) -> np.ndarray:
     rng = np.random.RandomState(seed)
     a = rng.randn(n, n).astype(np.float32) / np.sqrt(n)
     return (a @ a.T + np.eye(n, dtype=np.float32) * 4.0).astype(np.float32)
+
+
+def make_spd_fast(n: int, seed: int = 0) -> np.ndarray:
+    """A diagonally-dominant SPD matrix in O(n²) host work — the bench-scale
+    constructor (``make_spd``'s Gram product is an n³ host matmul: minutes
+    at n=16384).  Symmetric with diag ≳ Σ|off-diag| per row ⇒ SPD by
+    Gershgorin; entries ~N(0,1) keep the factors dense and well-scaled."""
+    rng = np.random.RandomState(seed)
+    a = rng.randn(n, n).astype(np.float32)
+    s = (a + a.T) * 0.5
+    np.fill_diagonal(s, np.abs(s).sum(axis=1) + 1.0)
+    return s

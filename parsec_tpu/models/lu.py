@@ -120,27 +120,32 @@ def _getrf_traceable(t):
 
 
 def _trsm_l_traceable(packed, c):
+    from ..ops.gemm import _precision as _mm_precision
     _, jnp, jsl = _jnp()
     n = packed.shape[0]
     L = jnp.tril(packed.astype(jnp.float32), -1) + jnp.eye(n)
     linv = jsl.solve_triangular(L, jnp.eye(n), lower=True,
                                 unit_diagonal=True)
-    return linv @ c.astype(jnp.float32)
+    return jnp.matmul(linv, c.astype(jnp.float32),
+                      precision=_mm_precision())
 
 
 def _trsm_u_traceable(packed, c):
+    from ..ops.gemm import _precision as _mm_precision
     _, jnp, jsl = _jnp()
     n = packed.shape[0]
     U = jnp.triu(packed.astype(jnp.float32))
     uinv = jsl.solve_triangular(U, jnp.eye(n), lower=False)
-    return c.astype(jnp.float32) @ uinv
+    return jnp.matmul(c.astype(jnp.float32), uinv,
+                      precision=_mm_precision())
 
 
 def _gemm_nn_traceable(a, b, c):
+    from ..ops.gemm import _precision as _mm_precision
     _, jnp, _ = _jnp()
     return c.astype(jnp.float32) - jnp.dot(
         a.astype(jnp.float32), b.astype(jnp.float32),
-        preferred_element_type=jnp.float32)
+        preferred_element_type=jnp.float32, precision=_mm_precision())
 
 
 def _tpu_body(traceable):

@@ -277,7 +277,14 @@ class JDF:
                         raise JDFError(
                             f"line {ar.line}: NEW is an input-only target")
                     continue    # `-> NULL`: the datum is dropped — no dep
-                if kind == "new" and dtt is None and fd.access != CTL:
+                if kind == "new" and fd.access == CTL:
+                    # CTL flows carry no data: nothing to allocate.  Reject
+                    # here with the line number instead of letting the DSL
+                    # layer surface a raw ValueError.
+                    raise JDFError(
+                        f"line {ar.line}: CTL flow {fd.name} cannot take "
+                        f"<- NEW (control flows carry no data)")
+                if kind == "new" and dtt is None:
                     # NEW allocates at the flow's declared type; JDF flows
                     # declare it through the arrow's [type=...] property
                     raise JDFError(

@@ -716,7 +716,10 @@ def _build_wavefront(tp, infos, stores: _Stores):
             cname = sig[0]
             info = infos[cname]
             G = len(members)
-            gathers = []    # per data flow: None | (name, rows|None, row0)
+            # per data flow: None | (name, kind, arg) with kind "const"
+            # (one row feeds the whole group), "range" (contiguous rows:
+            # a static slice, cheaper than a gather), or "gather"
+            gathers = []
             for fj in range(len(info.data_flows)):
                 ip0 = members[0][0][fj]
                 if ip0[0] == "none":
@@ -724,9 +727,11 @@ def _build_wavefront(tp, infos, stores: _Stores):
                     continue
                 rows = np.array([m[0][fj][2] for m in members], np.int32)
                 if (rows == rows[0]).all():
-                    gathers.append((ip0[1], None, int(rows[0])))
+                    gathers.append((ip0[1], "const", int(rows[0])))
+                elif (np.diff(rows) == 1).all():
+                    gathers.append((ip0[1], "range", int(rows[0])))
                 else:
-                    gathers.append((ip0[1], rows, None))
+                    gathers.append((ip0[1], "gather", rows))
             wi = {f.flow_index: j for j, f in enumerate(info.writable_flows)}
             scatters = []   # (name, rows array, src_kind, src_idx)
             for fj, f in enumerate(info.data_flows):
@@ -750,25 +755,49 @@ def _build_wavefront(tp, infos, stores: _Stores):
         level_specs.append(specs)
 
     # ---- emission ----------------------------------------------------------
+    def _apply_scatters(arr, entries):
+        """Apply one level's scatters to one store as a SINGLE update.
+        Separate ``.at[].set`` calls each copy the whole store; merging
+        them (and lowering contiguous row sets to a static slice update —
+        full-coverage levels like a stencil sweep become a plain slab
+        assignment) keeps the per-level cost at the data actually moved."""
+        import jax.numpy as jnp
+        rows_all = np.concatenate([rows for rows, _, _ in entries])
+        vals = []
+        for rows, v, batched in entries:
+            vals.append(v if batched
+                        else jnp.broadcast_to(v, (len(rows),) + v.shape))
+        v_all = vals[0] if len(vals) == 1 else jnp.concatenate(vals, axis=0)
+        order = np.argsort(rows_all, kind="stable")
+        srt = rows_all[order]
+        if (np.diff(srt) == 1).all():
+            if not (order == np.arange(len(order))).all():
+                v_all = v_all[order]
+            r0 = int(srt[0])
+            return arr.at[r0:r0 + len(srt)].set(v_all)
+        return arr.at[rows_all].set(v_all)
+
     def step_fn(st: dict) -> dict:
         import jax
-        import jax.numpy as jnp
         st = dict(st)
         saved = {name: st[name][rows]
                  for name, rows in dirty_by_name.items()}
         for specs in level_specs:
-            pend = []                        # scatters applied level-atomic
+            pend: dict[str, list] = {}       # scatters applied level-atomic
             for apply, gathers, scatters, G in specs:
                 args, axes = [], []
                 for gth in gathers:
                     if gth is None:
                         args.append(None)
                         axes.append(None)
-                    elif gth[1] is None:
+                    elif gth[1] == "const":
                         args.append(st[gth[0]][gth[2]])
                         axes.append(None)
+                    elif gth[1] == "range":
+                        args.append(st[gth[0]][gth[2]:gth[2] + G])
+                        axes.append(0)
                     else:
-                        args.append(st[gth[0]][gth[1]])
+                        args.append(st[gth[0]][gth[2]])
                         axes.append(0)
                 if G == 1 or all(ax is None for ax in axes):
                     res = apply(*args)
@@ -785,15 +814,12 @@ def _build_wavefront(tp, infos, stores: _Stores):
                         v, batched = res[src_idx], out_batched
                     else:
                         v, batched = args[src_idx], axes[src_idx] == 0
-                    pend.append((name, rows, v, batched))
-            for name, rows, v, batched in pend:
-                if batched:
-                    st[name] = st[name].at[rows].set(v)
-                elif len(rows) == 1:
-                    st[name] = st[name].at[int(rows[0])].set(v)
-                else:
-                    st[name] = st[name].at[rows].set(
-                        jnp.broadcast_to(v, (len(rows),) + v.shape))
+                    if not batched and len(rows) == 1 and v is not None:
+                        v = v[None]
+                        batched = True
+                    pend.setdefault(name, []).append((rows, v, batched))
+            for name, entries in pend.items():
+                st[name] = _apply_scatters(st[name], entries)
         for name, rows in dirty_by_name.items():
             st[name] = st[name].at[rows].set(saved[name])
         return st

@@ -49,6 +49,7 @@ def mk_engine(spy):
     eng.ce = spy
     eng._outq = {}
     eng._outq_lock = threading.Lock()
+    eng._flush_serial = threading.Lock()
     eng._outseq = itertools.count()
     return eng
 

@@ -474,6 +474,20 @@ class TestNewNullTargets:
         with pytest.raises(JDFError, match="NEW needs"):
             parse_jdf(src, "badnew").build(NB=1)
 
+    def test_new_on_ctl_flow_rejected_with_line(self):
+        src = """
+        NB [type = int]
+
+        T(i)
+          i = 0 .. 0
+          CTL X <- NEW
+        BODY
+          pass
+        END
+        """
+        with pytest.raises(JDFError, match=r"line \d+: CTL flow X"):
+            parse_jdf(src, "badctlnew").build(NB=1)
+
     def test_new_as_output_rejected(self):
         src = """
         NB [type = int]
