@@ -257,6 +257,64 @@ def bench_lowered_stencil_gflops(n: int = 1 << 24, mb: int = 1 << 18,
             "iterations": iterations, "mode": low.mode, "max_abs_err": err}
 
 
+def bench_dtd_gemm_tpu(n: int = 8192, nb: int = 1024) -> dict:
+    """DTD (dynamic task discovery) GEMM on the chip — the reference's
+    flagship DTD perf harness (``tests/dsl/dtd/dtd_test_simple_gemm.c:
+    649-667``): GEMM(m,n,k) tasks inserted at runtime, hazards discovered
+    from tile access chains, bodies dispatched through the TPU device
+    module (``tpu_kernel="gemm"`` chores, vmapped same-class batching)."""
+    import numpy as np
+
+    import parsec_tpu.ops.gemm  # noqa: F401  registers the "gemm" kernels
+    from parsec_tpu.device.tpu import init_tpu_devices
+    from parsec_tpu.dtd import INOUT, INPUT, DTDTaskpool
+    from parsec_tpu.runtime import Context
+
+    devs = init_tpu_devices()
+    if not devs:
+        return {"gflops": 0.0, "note": "no accelerator visible"}
+    dev = devs[0]
+    NT = n // nb
+    rng = np.random.default_rng(5)
+
+    def tile():
+        return rng.standard_normal((nb, nb), dtype=np.float32)
+
+    A = [[tile() for _ in range(NT)] for _ in range(NT)]
+    B = [[tile() for _ in range(NT)] for _ in range(NT)]
+    C = [[np.zeros((nb, nb), np.float32) for _ in range(NT)]
+         for _ in range(NT)]
+
+    def gemm(a, b, c):          # CPU incarnation (fallback chore)
+        c += a.astype(np.float32) @ b.astype(np.float32)
+
+    ctx = Context(nb_cores=0)
+    tp = DTDTaskpool()
+    ctx.add_taskpool(tp)
+    t0 = time.perf_counter()
+    for m in range(NT):
+        for n_ in range(NT):
+            for k in range(NT):
+                tp.insert_task(gemm, (A[m][k], INPUT), (B[k][n_], INPUT),
+                               (C[m][n_], INOUT), tpu_kernel="gemm")
+    tp.wait()
+    dev.sync()
+    t = time.perf_counter() - t0
+    # spot-check OUTSIDE the timed section: read the final (device) version
+    # of one C tile — a D2H pull, which through the axon relay times the
+    # tunnel (~70ms RTT/tile), not the framework (BASELINE.md env note)
+    got = np.asarray(tp.tile_of_array(C[0][0]).data.newest_copy().value)
+    ctx.fini()
+    want = np.zeros((nb, nb), np.float32)
+    for k in range(NT):
+        want += A[0][k] @ B[k][0]
+    err = float(np.max(np.abs(got - want)) / max(1.0, np.abs(want).max()))
+    return {"gflops": 2.0 * n * n * n / t / 1e9, "n": n, "nb": nb,
+            "seconds": t, "tile00_rel_err": err,
+            "tasks": dev.executed_tasks,
+            "batched_dispatches": dev.batched_dispatches}
+
+
 def bench_dispatch_us(ntasks: int = 2000) -> float:
     """Per-task dispatch latency on the EP DAG (the reference's
     tests/runtime/scheduling/ep.jdf shape): enqueue-to-drain wall time over
@@ -302,6 +360,7 @@ def main() -> None:
     lsten = bench_lowered_stencil_gflops()
     lchol = bench_lowered_cholesky_gflops()
     dyn = bench_dynamic_gemm_gflops()
+    dtd = bench_dtd_gemm_tpu()
     chol = bench_dynamic_cholesky_gflops()
     gemm = bench_gemm_gflops(n=n)
     target = 0.70 * gemm["peak_gflops"]
@@ -320,6 +379,7 @@ def main() -> None:
             "task_dispatch_us": round(dispatch_us, 2),
             "dynamic_gemm_gflops": round(dyn.get("gflops", 0.0), 1),
             "dynamic_gemm_batched": dyn.get("batched_dispatches", 0),
+            "dtd_gemm_tpu_gflops": round(dtd.get("gflops", 0.0), 1),
             "dynamic_cholesky_gflops": round(chol.get("gflops", 0.0), 1),
             "lowered_cholesky_gflops": round(lchol.get("gflops", 0.0), 1),
             "lowered_cholesky_n": lchol.get("n", 0),

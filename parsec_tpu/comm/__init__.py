@@ -16,7 +16,12 @@ Rebuild of the reference's communication stack (SURVEY §2.6, §3.4, §5.8):
   shared fabric (the test-facing analog of ``mpiexec -np N``).
 - :mod:`socket_fabric` / :mod:`multiproc` — the multi-PROCESS tier: ranks
   as separate interpreters over TCP (``run_multiproc``, the true mpiexec
-  analog; set ``PARSEC_TPU_HOSTS`` for multi-host).
+  analog; set ``PARSEC_TPU_HOSTS`` for multi-host), with seq/replay/ack
+  delivery guarantees over breakable connections.
+- :mod:`device_socket` — the deployable DCN tier:
+  ``run_multiproc(transport="device")`` binds one JAX device per rank,
+  registered payloads live device-resident, GETs land straight on the
+  consumer's device, and ``jax.distributed`` bootstraps real pods.
 """
 
 from .engine import (AM_TAG_ACTIVATE, AM_TAG_GET_ACK, AM_TAG_TERMDET,
@@ -24,10 +29,12 @@ from .engine import (AM_TAG_ACTIVATE, AM_TAG_GET_ACK, AM_TAG_TERMDET,
 from .remote_dep import RemoteDepEngine, RemoteDeps
 from .multirank import run_multirank
 from .multiproc import run_multiproc
+from .device_socket import DeviceSocketCommEngine
 from .termdet_fourcounter import FourCounterTermDet  # registers the component
 
 __all__ = [
     "CommEngine", "InprocFabric", "MemHandle", "RemoteDepEngine",
-    "RemoteDeps", "FourCounterTermDet", "run_multirank", "run_multiproc", "AM_TAG_ACTIVATE",
+    "RemoteDeps", "FourCounterTermDet", "run_multirank", "run_multiproc",
+    "DeviceSocketCommEngine", "AM_TAG_ACTIVATE",
     "AM_TAG_GET_ACK", "AM_TAG_TERMDET",
 ]

@@ -51,10 +51,15 @@ def _free_port_base(nranks: int) -> int:
 
 
 def run_multiproc(nranks: int, target: str, timeout: float = 180.0,
-                  nb_cores: int = 0) -> list[Any]:
+                  nb_cores: int = 0, transport: str = "socket") -> list[Any]:
     """Run ``target`` on ``nranks`` subprocess ranks; returns the per-rank
     results.  Retries once on a lost port-range race (a bind collision
     surfaces as one rank failing, or as a timeout of the survivors).
+
+    ``transport``: ``"socket"`` (host-object payloads) or ``"device"`` —
+    each rank binds one JAX device, registered payloads live
+    device-resident, and GETs land directly on the consumer's device
+    (:mod:`parsec_tpu.comm.device_socket`, the deployable DCN tier).
 
     Execution is therefore **at-least-once**: on the retry path every rank
     body runs again from scratch, so bodies with external side effects
@@ -62,16 +67,18 @@ def run_multiproc(nranks: int, target: str, timeout: float = 180.0,
     attempt.  The collision happens while the socket fabric bootstraps —
     normally before any user code runs — but a partially-connected mesh can
     have let early ranks start their bodies before the failure surfaced."""
+    if transport not in ("socket", "device"):
+        raise ValueError(f"unknown transport {transport!r}")
     try:
-        return _run_multiproc(nranks, target, timeout, nb_cores)
+        return _run_multiproc(nranks, target, timeout, nb_cores, transport)
     except (RuntimeError, TimeoutError) as e:
         if "Address already in use" not in str(e):
             raise
-        return _run_multiproc(nranks, target, timeout, nb_cores)
+        return _run_multiproc(nranks, target, timeout, nb_cores, transport)
 
 
 def _run_multiproc(nranks: int, target: str, timeout: float,
-                   nb_cores: int) -> list[Any]:
+                   nb_cores: int, transport: str = "socket") -> list[Any]:
     base = _free_port_base(nranks)
     tmp = tempfile.mkdtemp(prefix="parsec_mp_")
     env = dict(os.environ)
@@ -87,6 +94,7 @@ def _run_multiproc(nranks: int, target: str, timeout: float,
     env["PARSEC_MP_BASE_PORT"] = str(base)
     env["PARSEC_MP_NB_CORES"] = str(nb_cores)
     env["PARSEC_MP_TIMEOUT"] = str(timeout)
+    env["PARSEC_MP_TRANSPORT"] = transport
     procs: list[subprocess.Popen] = []
     logs: list[str] = []
     try:
@@ -174,6 +182,13 @@ def _rank_main() -> None:
     from .remote_dep import RemoteDepEngine
     from .socket_fabric import SocketCommEngine, SocketFabric
 
+    transport = os.environ.get("PARSEC_MP_TRANSPORT", "socket")
+    if transport == "device":
+        # real-pod hook: with a coordinator configured this initializes
+        # jax.distributed so the process sees its local chips
+        from .device_socket import maybe_init_distributed
+        maybe_init_distributed()
+
     rank = int(os.environ["PARSEC_MP_RANK"])
     nranks = int(os.environ["PARSEC_MP_NRANKS"])
     base = int(os.environ["PARSEC_MP_BASE_PORT"])
@@ -190,7 +205,12 @@ def _rank_main() -> None:
 
     fabric = SocketFabric(nranks, rank, base_port=base)
     ctx = Context(nb_cores=nb_cores, nb_ranks=nranks, my_rank=rank)
-    eng = RemoteDepEngine(ctx, SocketCommEngine(fabric))
+    if transport == "device":
+        from .device_socket import DeviceSocketCommEngine
+        ce = DeviceSocketCommEngine(fabric)
+    else:
+        ce = SocketCommEngine(fabric)
+    eng = RemoteDepEngine(ctx, ce)
     ctx.start()
     result = fn(ctx, rank, nranks)
     # context-level drain before teardown (the run_multirank discipline)

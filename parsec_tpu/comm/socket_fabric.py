@@ -8,8 +8,9 @@ TCP, and the entire protocol stack above the engine vtable — remote-dep
 activation, propagation trees, coalescing, termdet waves, DTD pushes —
 runs unchanged (``RemoteDepEngine`` never learns which fabric it rides).
 
-Wire format: length-prefixed pickles of ``("d", seq, tag, src, payload)``
-data frames and ``("a", src, upto)`` cumulative acks.  Topology: rank *i*
+Wire format: length-prefixed pickles of ``("d", seq, body)`` data frames
+(``body`` = the pickled ``(tag, src, payload)``, serialized outside the
+per-peer send lock) and ``("a", src, upto)`` cumulative acks.  Topology: rank *i*
 listens on ``base_port + i``; outgoing connections are made lazily with
 connect-retry (peers boot in any order).  The host list defaults to
 localhost (the oversubscribed test form — real multi-host runs set
@@ -106,6 +107,7 @@ class SocketFabric:
         self._unacked_in: dict[int, int] = {}
         self.replays = 0          # reconnect-and-replay events (observable)
         self.dup_frames = 0       # duplicate frames suppressed
+        self.bytes_sent = 0       # total framed bytes (traffic accounting)
         # fault injection (tests): break the connection before some sends
         fault_p = float(_params.get("comm_socket_fault_p"))
         self._fault_p = fault_p
@@ -190,7 +192,8 @@ class SocketFabric:
                 _, src, upto = frame
                 self._prune_unacked(src, upto)
                 continue
-            _, seq, tag, src, payload = frame
+            _, seq, body = frame
+            tag, src, payload = pickle.loads(body)
             ack_now = None
             with self._ilock:
                 if seq <= self._seen.get(src, 0):
@@ -222,39 +225,38 @@ class SocketFabric:
         ack just leaves the peer's window larger until the next one).
         Runs on a receive thread, so a missing reverse connection gets only
         a SHORT connect budget — stalling reception behind a 30s boot retry
-        would freeze frames already queued on this connection."""
+        would freeze frames already queued on this connection.  A failed
+        send DROPS the socket (the next ack reconnects) and never declares
+        the peer dead — a receive-only rank's ack channel would otherwise
+        stay wedged after one reset and starve the sender's window."""
         with self._plock:
             ent = self._peers.get(src)
             if ent is None:
                 ent = self._peers[src] = [None, threading.Lock(), 0, deque()]
-        try:
-            with ent[1]:
+        with ent[1]:
+            try:
                 if ent[0] is None:
-                    ent[0] = self._connect(src, retry_s=2.0)
+                    ent[0] = self._connect(src, retry_s=2.0,
+                                           report_dead=False)
                 ent[0].sendall(_frame(("a", self.rank, upto)))
-        except OSError:
-            pass
+            except OSError:
+                if ent[0] is not None:
+                    try:
+                        ent[0].close()
+                    except OSError:
+                        pass
+                    ent[0] = None
 
     # --------------------------------------------------------------- send
-    def _peer(self, dst: int) -> tuple[socket.socket | None, threading.Lock]:
-        """The (socket, send-lock) pair for ``dst``.  The global lock only
-        installs the per-destination slot; the (up to 30s) connect-retry
-        runs under the slot's own lock, so a slow-booting peer never
-        stalls sends to peers that are already connected."""
-        with self._plock:
-            ent = self._peers.get(dst)
-            if ent is None:
-                ent = self._peers[dst] = [None, threading.Lock(), 0, deque()]
-        with ent[1]:
-            if ent[0] is None:
-                ent[0] = self._connect(dst)
-        return ent[0], ent[1]
-
-    def _connect(self, dst: int, retry_s: float = 30.0) -> socket.socket:
+    def _connect(self, dst: int, retry_s: float = 30.0,
+                 report_dead: bool = True) -> socket.socket:
         """Connect to ``dst``, retrying refusals for up to ``retry_s`` (30s
-        default covers peers still booting; reconnect/ack paths pass a short
+        default covers peers still booting; reconnect paths pass a short
         budget — a peer dead mid-run should fail fast, not hang callers for
-        the boot window).  Bails immediately on fabric teardown."""
+        the boot window).  Bails immediately on fabric teardown.
+        ``report_dead=False`` suppresses the peer-death notification —
+        best-effort paths (acks) must not declare a live peer dead off a
+        short transient budget."""
         deadline = time.monotonic() + retry_s
         while True:
             if self._stop.is_set():
@@ -265,7 +267,8 @@ class SocketFabric:
                 break
             except OSError:
                 if time.monotonic() > deadline:
-                    self._peer_dead(dst)
+                    if report_dead:
+                        self._peer_dead(dst)
                     raise
                 time.sleep(0.05)   # peer still booting
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -287,6 +290,11 @@ class SocketFabric:
             with self._ilock:
                 self._inbox.append((tag, src, payload))
             return
+        # the expensive serialization (payload object graph) runs OUTSIDE
+        # the send lock; only the tiny seq-stamped envelope (a bytes
+        # memcpy) is built inside it
+        body = pickle.dumps((tag, src, payload),
+                            protocol=pickle.HIGHEST_PROTOCOL)
         with self._plock:
             ent = self._peers.get(dst)
             if ent is None:
@@ -298,7 +306,8 @@ class SocketFabric:
                     f"({len(ent[3])} unacked frames) — peer stopped acking")
             ent[2] += 1
             seq = ent[2]
-            data = _frame(("d", seq, tag, src, payload))
+            data = _frame(("d", seq, body))
+            self.bytes_sent += len(data)
             ent[3].append((seq, data))
             if ent[0] is None:
                 ent[0] = self._connect(dst)

@@ -33,6 +33,12 @@ class PinsEvent(IntEnum):
     DATA_FLUSH_END = 15
     TASKPOOL_INIT = 16
     TASKPOOL_FINI = 17
+    # compiled-DAG executor batch spans (payload: batch size) — the fast
+    # path stays observable instead of falling back when PINS is active
+    DAG_FETCH_BEGIN = 18
+    DAG_FETCH_END = 19
+    DAG_COMPLETE_BEGIN = 20
+    DAG_COMPLETE_END = 21
 
 
 Callback = Callable[[Any, Any], None]   # (execution_stream_or_none, payload)

@@ -996,6 +996,8 @@ class LoweredTaskpool:
 
     def execute(self) -> dict[str, Any]:
         import jax
+
+        from ..prof.profiling import profiling
         if self._jitted is None:
             if self.mesh is not None:
                 sh = self.shardings()
@@ -1003,8 +1005,19 @@ class LoweredTaskpool:
                                        out_shardings=sh)
             else:
                 self._jitted = jax.jit(self.step_fn)
+        # one trace span per compiled execution (the lowered analog of the
+        # task_profiler's exec phase): the fast path stays observable
+        keys = None
+        if profiling.enabled:
+            keys = profiling.add_dictionary_keyword(
+                "lowered_execute", "#00aaff", ("taskpool", "mode"))
+            profiling.trace(keys[0], object_id=id(self),
+                            info={"taskpool": self.taskpool.name,
+                                  "mode": self.mode})
         out = self._jitted(self.initial_stores())
         self._stores.writeback(out)
+        if keys is not None:
+            profiling.trace(keys[1], object_id=id(self))
         return out
 
 

@@ -22,9 +22,15 @@ Python lock, dict, or Task attribute.
 
 Compilation is an optimization with the exact fallback discipline of
 :mod:`parsec_tpu.ptg.lowering`: any structural surprise (device chores,
-custom prepare_input, multi-dep data flows, non-enumerable spaces, PINS
-instrumentation active) falls back to the dynamic scheduler — same taskpool
-object, same results.
+custom prepare_input, multi-dep data flows, non-enumerable spaces) falls
+back to the dynamic scheduler — same taskpool object, same results.
+
+PINS instrumentation does NOT force the fallback (the round-3 state, which
+made the 1.4µs hot loop unobservable — the reference profiles its real
+inner loop, ``mca/pins/pins_task_profiler.c``): with PINS active the
+executor fires batch-granular ``DAG_FETCH``/``DAG_COMPLETE`` spans (payload:
+batch size) and per-task ``EXEC`` begin/end around the bodies; with PINS
+off the hot loop is byte-identical to before (one bool test per batch).
 """
 
 from __future__ import annotations
@@ -124,8 +130,13 @@ class _CompiledDagBase:
                 with self._lock:
                     self._claimed = False
                 return False
+            instr = pins.enabled        # one test per batch, not per task
+            if instr:
+                pins.fire(pins.PinsEvent.DAG_FETCH_BEGIN, es, None)
             n = fetch(buf, _BATCH)
             ids = list(buf[:n]) if n else []
+            if instr:
+                pins.fire(pins.PinsEvent.DAG_FETCH_END, es, len(ids))
             if not ids and not retry:
                 if self._ndag.remaining() == 0:
                     break
@@ -139,11 +150,16 @@ class _CompiledDagBase:
             if done:
                 self._noprog = 0
                 rem = -1
+                if instr:
+                    pins.fire(pins.PinsEvent.DAG_COMPLETE_BEGIN, es,
+                              len(done))
                 for off in range(0, len(done), _BATCH):
                     chunk = done[off:off + _BATCH]
                     for j, gid in enumerate(chunk):
                         buf[j] = gid
                     rem = complete(buf, len(chunk))
+                if instr:
+                    pins.fire(pins.PinsEvent.DAG_COMPLETE_END, es, len(done))
                 if rem == 0:
                     break
                 backoff.reset()
@@ -185,6 +201,9 @@ class CompiledDag(_CompiledDagBase):
         tasks, hooks = self._tasks, self._hooks
         pres, posts = self._pres, self._posts
         DONE, AGAIN = HOOK_RETURN_DONE, HOOK_RETURN_AGAIN
+        instr = pins.enabled
+        fire = pins.fire
+        EB, EE = pins.PinsEvent.EXEC_BEGIN, pins.PinsEvent.EXEC_END
         done: list[int] = []
         retry: list[int] = []
         for gid in ids:
@@ -195,7 +214,12 @@ class CompiledDag(_CompiledDagBase):
                 for fi, dtt in pre:
                     if data[fi] is None:
                         data[fi] = _scratch(dtt)
-            rc = hooks[gid](es, t)
+            if instr:
+                fire(EB, es, t)
+                rc = hooks[gid](es, t)
+                fire(EE, es, t)
+            else:
+                rc = hooks[gid](es, t)
             if rc != DONE:
                 if rc == AGAIN:
                     retry.append(gid)
@@ -278,6 +302,9 @@ class VecCompiledDag(_CompiledDagBase):
             # change in _build's pure_ctl branch.
             empty = (None,) * len(tc.flows)
             nchores = (1 << len(tc.chores)) - 1
+            instr = pins.enabled
+            fire = pins.fire
+            EB, EE = pins.PinsEvent.EXEC_BEGIN, pins.PinsEvent.EXEC_END
             for gid, row in zip(gids, rows):
                 t = new_task(Task)
                 t.taskpool = tp
@@ -291,7 +318,12 @@ class VecCompiledDag(_CompiledDagBase):
                 t.chore_mask = nchores
                 t.selected_device = None
                 t.on_complete = None
-                rc = hook(es, t)
+                if instr:
+                    fire(EB, es, t)
+                    rc = hook(es, t)
+                    fire(EE, es, t)
+                else:
+                    rc = hook(es, t)
                 if rc != DONE:
                     if rc == AGAIN:
                         retry.append(gid)
@@ -308,8 +340,6 @@ def compile_taskpool_dag(tp, context) -> CompiledDag | None:
         return None
     if getattr(context, "nb_ranks", 1) > 1:
         return None            # multi-rank release goes through remote_dep
-    if pins.enabled:
-        return None            # per-task instrumentation needs the full loop
     builders = getattr(tp, "_tc_builders", None)
     if builders is None:
         return None            # only enumerable PTG pools compile

@@ -37,6 +37,72 @@ def chain_body(ctx, rank, nranks):
     return None
 
 
+def device_bcast_gemm_body(ctx, rank, nranks):
+    """Stage-1-equivalent over the device-resident multi-process tier:
+    an Ex05-shaped broadcast (payload big enough for the rendezvous GET
+    path) followed by a 2-D block-cyclic GEMM, with per-tier byte
+    accounting returned for the parent to assert."""
+    from parsec_tpu import ptg
+    from parsec_tpu.comm.device_socket import DeviceSocketCommEngine
+    from parsec_tpu.data.data import data_create
+    from parsec_tpu.data_dist.matrix import (TwoDimBlockCyclic,
+                                             VectorTwoDimCyclic)
+    from parsec_tpu.models.tiled_gemm import tiled_gemm_ptg
+
+    ce = ctx.comm_engine.ce
+    assert isinstance(ce, DeviceSocketCommEngine), type(ce)
+
+    # --- broadcast: one writer, every rank a reader -----------------------
+    V = VectorTwoDimCyclic("V", lm=nranks, mb=1, P=nranks, myrank=rank,
+                           init_fn=lambda m, size: np.zeros(size))
+    p = ptg.PTGBuilder("bcast", V=V, NR=nranks)
+    w = p.task("W", z=ptg.span(0, 0))
+    w.affinity("V", lambda g, l: (0,))
+    fw = w.flow("A", ptg.WRITE)
+    for r in range(nranks):
+        fw.output(succ=("R", "X", lambda g, l, r=r: {"r": r}))
+
+    def wbody(es, task, g, l):
+        arr = np.arange(4096, dtype=np.float32)    # > comm_short_limit
+        task.set_flow_data("A", data_create(arr, key=("w", 0)).get_copy(0))
+
+    w.body(wbody)
+    t = p.task("R", r=ptg.span(0, lambda g, l: g.NR - 1))
+    t.affinity("V", lambda g, l: (l.r,))
+    fx = t.flow("X", ptg.READ)
+    fx.input(pred=("W", "A", lambda g, l: {"z": 0}))
+    fy = t.flow("Y", ptg.RW)
+    fy.input(data=("V", lambda g, l: (l.r,)))
+    fy.output(data=("V", lambda g, l: (l.r,)))
+
+    def rbody(es, task, g, l):
+        y = task.flow_data("Y")
+        y.value = np.full_like(np.asarray(y.value),
+                               float(np.asarray(
+                                   task.flow_data("X").value).sum()))
+
+    t.body(rbody)
+    ctx.add_taskpool(p.build())
+    ctx.wait(timeout=90)
+    ctx.comm_barrier()
+    bsum = float(np.asarray(V.data_of(rank).newest_copy().value)[0])
+
+    # --- 2-D block-cyclic GEMM over the same engine -----------------------
+    n, nb = 64, 16
+    rng = np.random.RandomState(23)
+    a = rng.randn(n, n).astype(np.float32)
+    b = rng.randn(n, n).astype(np.float32)
+    P = 2 if nranks % 2 == 0 else 1
+    Q = nranks // P
+    A = TwoDimBlockCyclic.from_dense("A", a, nb, nb, P=P, Q=Q, myrank=rank)
+    B = TwoDimBlockCyclic.from_dense("B", b, nb, nb, P=P, Q=Q, myrank=rank)
+    C = TwoDimBlockCyclic("C", n, n, nb, nb, P=P, Q=Q, myrank=rank)
+    ctx.add_taskpool(tiled_gemm_ptg(A, B, C, devices="cpu"))
+    ctx.wait(timeout=120)
+    ctx.comm_barrier()
+    return {"bsum": bsum, "C": C.to_dense(), "tiers": ce.tier_bytes()}
+
+
 def gemm_body(ctx, rank, nranks):
     """Block-cyclic GEMM with remote deps over the socket fabric."""
     from parsec_tpu.data_dist.matrix import TwoDimBlockCyclic

@@ -145,9 +145,10 @@ def _small_pool(trace=None):
 
 
 class TestDebugMarks:
-    def test_ring_captures_events(self):
+    def test_ring_captures_events(self, param):
         from parsec_tpu.core.mca import repository
         from parsec_tpu.prof import debug_marks
+        param("runtime_dag_compile", False)   # marks watch the full loop
         comp = repository.find("pins", "debug_marks")
         mod = comp.open()   # install re-creates the module-level ring
         ring = debug_marks.ring

@@ -254,17 +254,27 @@ class TestFallbacks:
         ctx.nb_ranks = 1
         ctx.fini()
 
-    def test_pins_active_falls_back(self):
+    def test_pins_active_still_compiles_and_fires_events(self):
+        """Round-4 contract flip: PINS no longer forces the dynamic
+        fallback — the fast path compiles AND emits per-task EXEC plus
+        batch-granular DAG_FETCH/DAG_COMPLETE events."""
         from parsec_tpu.prof import pins
-        cb = lambda es, payload: None
-        pins.register(pins.PinsEvent.EXEC_BEGIN, cb)
+        execs, batches = [], []
+        cb_e = lambda es, t: execs.append(t.uid)
+        cb_b = lambda es, n: batches.append(n)
+        pins.register(pins.PinsEvent.EXEC_BEGIN, cb_e)
+        pins.register(pins.PinsEvent.DAG_COMPLETE_END, cb_b)
         try:
             tp = ep_pool()
             ctx = Context(nb_cores=0)
-            assert compile_taskpool_dag(tp, ctx) is None
+            assert compile_taskpool_dag(tp, ctx) is not None
             ctx.fini()
+            run_pool(ep_pool(), nb_cores=0)
         finally:
-            pins.unregister(pins.PinsEvent.EXEC_BEGIN, cb)
+            pins.unregister(pins.PinsEvent.EXEC_BEGIN, cb_e)
+            pins.unregister(pins.PinsEvent.DAG_COMPLETE_END, cb_b)
+        assert sorted(execs) == list(range(8 * 5))   # every task observed
+        assert batches and sum(batches) == 8 * 5     # batch sizes accounted
 
     def test_param_gate(self, dynamic_only):
         tp = ep_pool()
