@@ -78,8 +78,12 @@ class DeviceSocketCommEngine(SocketCommEngine):
                      owned: bool = False,
                      peers: set[int] | None = None) -> MemHandle:
         import jax
-        if not owned and isinstance(value, np.ndarray):
-            value = value.copy()    # device_put may zero-copy-alias on CPU
+        if not owned and isinstance(value, np.ndarray) \
+                and self.device.platform == "cpu":
+            # device_put may zero-copy-alias host memory on the CPU backend
+            # only; a real accelerator already pays a physical H2D copy, so
+            # the defensive host copy would be pure critical-path waste
+            value = value.copy()
         if not is_device_array(value) or value.device != self.device:
             value = jax.device_put(value, self.device)
         return super().mem_register(value, refcount, on_drained, owned=True,

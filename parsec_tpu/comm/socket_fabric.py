@@ -307,7 +307,11 @@ class SocketFabric:
             ent[2] += 1
             seq = ent[2]
             data = _frame(("d", seq, body))
-            self.bytes_sent += len(data)
+            # bytes_sent is shared across peers; concurrent senders hold
+            # different per-peer locks, so the read-modify-write needs the
+            # peer-table lock to not lose increments
+            with self._plock:
+                self.bytes_sent += len(data)
             ent[3].append((seq, data))
             if ent[0] is None:
                 ent[0] = self._connect(dst)

@@ -285,8 +285,47 @@ class SubtileCollection(TiledMatrix):
                          init_fn=view)
 
     def sync_parent(self) -> None:
-        """Mark the parent tile's host copy newer than any device copy."""
+        """Publish the sub-tiles into the parent copy and outrank any device
+        copy of it.  Sub-tiles that are still live views are no-op copies;
+        sub-tiles whose bodies *rebound* the value (the common case — e.g.
+        ``gemm_cpu_body`` rebinds C) are written back explicitly, so the
+        recursive-call contract holds for either body style."""
+        parent = np.asarray(self.parent_copy.value)
+        if parent.flags.writeable:
+            out = parent
+        else:   # device-array parent: assemble a fresh host array
+            out = parent.copy()
+        for m in range(self.mt):
+            for n in range(self.nt):
+                t = np.asarray(self.data_of(m, n).newest_copy().value)
+                # if t is still the live view this writes a region onto
+                # itself (harmless); if the body rebound it, this publishes
+                out[m * self.mb:m * self.mb + t.shape[0],
+                    n * self.nb:n * self.nb + t.shape[1]] = t
+        if out is not parent:
+            self.parent_copy.value = out
         self.parent_copy.version += 1
+
+    @classmethod
+    def of_copy(cls, copy: Any, sub_mb: int, sub_nb: int,
+                name: str = "subview") -> "SubtileCollection":
+        """View an arbitrary :class:`DataCopy`'s array as a tiled matrix —
+        the form recursive task bodies use on their *flow* copies (the
+        flow copy of a chained RW tile need not be the collection's home
+        copy, so the parent-collection constructor would alias the wrong
+        buffer)."""
+        self = cls.__new__(cls)
+        self.parent = None
+        self.parent_copy = copy
+        array = np.asarray(copy.value)
+
+        def view(mm, nn, shape):
+            return array[mm * sub_mb:mm * sub_mb + shape[0],
+                         nn * sub_nb:nn * sub_nb + shape[1]]
+
+        TiledMatrix.__init__(self, name, array.shape[0], array.shape[1],
+                             sub_mb, sub_nb, dtype=array.dtype, init_fn=view)
+        return self
 
 
 class HashDataDist(DataCollection):

@@ -73,6 +73,68 @@ def _cpu_wrap(es: Any, task: Any, g: Any, l: Any) -> None:
     gemm_ops.gemm_cpu_body(es, task)
 
 
+def tiled_gemm_recursive_ptg(A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
+                             sub_mb: int, sub_nb: int,
+                             min_tile: int = 0) -> ptg.PTGTaskpool:
+    """GEMM PTG whose bodies *recurse*: each GEMM(m,n,k) spawns a nested
+    tiled-GEMM taskpool over (sub_mb, sub_nb) sub-tiles of its own flow
+    tiles and detaches until it drains — the ``PARSEC_DEV_RECURSIVE``
+    pattern (``parsec/recursive.h``, ``device.h:64``) on the flagship app.
+
+    ``min_tile`` is the recursion cutoff (the role of the evaluate hook in
+    reference recursive chores): tiles with both dims <= ``min_tile`` run
+    the plain CPU GEMM body instead of recursing.
+    """
+    MT, NT, KT = C.mt, C.nt, A.nt
+    assert A.mt == MT and B.nt == NT and B.mt == KT
+
+    p = ptg.PTGBuilder("tiled_gemm_rec", A=A, B=B, C=C, MT=MT, NT=NT, KT=KT)
+    t = p.task("GEMM",
+               m=ptg.span(0, lambda g, l: g.MT - 1),
+               n=ptg.span(0, lambda g, l: g.NT - 1),
+               k=ptg.span(0, lambda g, l: g.KT - 1))
+    t.affinity("C", lambda g, l: (l.m, l.n))
+    t.priority(lambda g, l: g.KT - l.k)
+    fa = t.flow("A", ptg.READ)
+    fa.input(data=("A", lambda g, l: (l.m, l.k)))
+    fb = t.flow("B", ptg.READ)
+    fb.input(data=("B", lambda g, l: (l.k, l.n)))
+    fc = t.flow("C", ptg.RW)
+    fc.input(data=("C", lambda g, l: (l.m, l.n)), guard=lambda g, l: l.k == 0)
+    fc.input(pred=("GEMM", "C", lambda g, l: {"m": l.m, "n": l.n, "k": l.k - 1}),
+             guard=lambda g, l: l.k > 0)
+    fc.output(succ=("GEMM", "C", lambda g, l: {"m": l.m, "n": l.n, "k": l.k + 1}),
+              guard=lambda g, l: l.k < g.KT - 1)
+    fc.output(data=("C", lambda g, l: (l.m, l.n)),
+              guard=lambda g, l: l.k == g.KT - 1)
+
+    def _too_small(es: Any, task: Any) -> int:
+        from ..runtime.task import HOOK_RETURN_NEXT
+        shape = np.asarray(task.data[2].value).shape
+        if max(shape) <= min_tile:
+            return HOOK_RETURN_NEXT     # fall through to the plain CPU chore
+        return 0
+
+    def _recurse(es: Any, task: Any, g: Any, l: Any) -> int:
+        from ..data_dist.matrix import SubtileCollection
+        from ..runtime.recursive import recursive_call
+        a = SubtileCollection.of_copy(task.data[0], sub_mb, sub_nb,
+                                      name=f"Asub{task.key}")
+        b = SubtileCollection.of_copy(task.data[1], sub_mb, sub_nb,
+                                      name=f"Bsub{task.key}")
+        c = SubtileCollection.of_copy(task.data[2], sub_mb, sub_nb,
+                                      name=f"Csub{task.key}")
+        inner = tiled_gemm_ptg(a, b, c, devices="cpu")
+        # sync_parent on C publishes the sub-writes into the outer flow copy
+        # before the outer completion walks its out-deps
+        return recursive_call(es, task, inner, collections=(c,))
+
+    t.body(_recurse, device="recursive",
+           evaluate=_too_small if min_tile else None)
+    t.body(_cpu_wrap, device="cpu")
+    return p.build()
+
+
 @functools.partial(jax.jit, static_argnames=("precision",))
 def _fused_gemm(a, b, c, precision=None):
     return c + jnp.dot(a, b, preferred_element_type=c.dtype,

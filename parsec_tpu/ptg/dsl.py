@@ -163,7 +163,10 @@ class TaskClassBuilder:
         incarnation contract).  Usable as a decorator: ``@tc.body``.
         """
         def attach(f: Callable | None) -> Callable | None:
-            if device == "cpu":
+            if device in ("cpu", "recursive"):
+                # recursive incarnations are host callables too: the body
+                # spawns a nested taskpool via runtime.recursive_call and
+                # returns its ASYNC (PARSEC_DEV_RECURSIVE, device.h:64)
                 hook = self._wrap_cpu_body(f)
             else:
                 from ..device.hooks import make_device_hook
@@ -260,7 +263,8 @@ class PTGTaskpool(Taskpool):
         """Count tasks whose affinity lands on this rank (generated
         ``nb_local_tasks_fn`` analog)."""
         my_rank = self.context.my_rank if self.context else 0
-        multi = self.context is not None and self.context.nb_ranks > 1
+        multi = (self.context is not None and self.context.nb_ranks > 1
+                 and not self.local_only)
         n = 0
         for tc in self.task_classes:
             tcb = self._tc_builders[tc.name]
@@ -278,7 +282,7 @@ class PTGTaskpool(Taskpool):
         """Enumerate initially-ready local tasks (empty IN-dep mask)."""
         from ..runtime.scheduling import resolve_data_inputs
         from ..runtime.task import Task
-        multi = context.nb_ranks > 1
+        multi = context.nb_ranks > 1 and not self.local_only
         out = []
         for tc in self.task_classes:
             tcb = self._tc_builders[tc.name]

@@ -9,6 +9,7 @@ from .task import (DEV_CPU, DEV_RECURSIVE, DEV_TPU, FLOW_CTL,
                    HOOK_RETURN_AGAIN, HOOK_RETURN_ASYNC, HOOK_RETURN_DISABLE,
                    HOOK_RETURN_DONE, HOOK_RETURN_ERROR, HOOK_RETURN_NEXT,
                    Chore, Dep, Flow, Task, TaskClass)
+from .recursive import recursive_call
 from .taskpool import CompoundTaskpool, Taskpool, compose, taskpool_lookup
 from .termdet import (LocalTermDet, TermDetMonitor, UserTriggerTermDet)
 
@@ -19,6 +20,6 @@ __all__ = [
     "HOOK_RETURN_DONE", "HOOK_RETURN_ERROR", "HOOK_RETURN_NEXT",
     "LocalTermDet", "Task", "TaskClass", "Taskpool", "TermDetMonitor",
     "UserTriggerTermDet", "VirtualProcess", "complete_execution", "compose",
-    "execute_task", "prepare_input", "release_deps", "schedule_tasks",
-    "select_task", "task_progress", "taskpool_lookup",
+    "execute_task", "prepare_input", "release_deps", "recursive_call",
+    "schedule_tasks", "select_task", "task_progress", "taskpool_lookup",
 ]

@@ -109,19 +109,30 @@ class Context:
                 t.start()
 
     # ------------------------------------------------------------------ API
-    def add_taskpool(self, tp: Taskpool) -> None:
-        """``parsec_context_add_taskpool`` (``scheduling.c:850``)."""
+    def add_taskpool(self, tp: Taskpool, local_only: bool = False) -> None:
+        """``parsec_context_add_taskpool`` (``scheduling.c:850``).
+
+        ``local_only`` marks a rank-private pool (nested pools spawned by
+        recursive task bodies, ``runtime/recursive.py``): it gets a local
+        termination detector and NO comm id, so it never participates in
+        the wire protocol and ranks may enqueue different numbers of them
+        without desynchronizing the rank-agreed taskpool id sequence."""
         tp.context = self
+        tp.local_only = local_only = tp.local_only or local_only
         pins.fire(PinsEvent.TASKPOOL_INIT, None, tp)
         if tp.tdm is None:
-            name = _params.get("termdet") or "local"
+            name = "local" if local_only else \
+                (_params.get("termdet") or "local")
             tp.tdm = repository.query("termdet", requested=name).open(self)
         tp.tdm.monitor_taskpool(tp, tp.terminated)
         with self._lock:
             self._active_taskpools.append(tp)
-            self.taskpool_list.append(tp)
-            tp.comm_id = len(self.taskpool_list)
-            self._tp_by_comm_id[tp.comm_id] = tp
+            if local_only:
+                tp.comm_id = None
+            else:
+                self.taskpool_list.append(tp)
+                tp.comm_id = len(self.taskpool_list)
+                self._tp_by_comm_id[tp.comm_id] = tp
         if tp.on_enqueue is not None:
             tp.on_enqueue(tp)
         # compiled-DAG incarnation: enumerable single-rank PTG pools skip the
@@ -135,7 +146,7 @@ class Context:
             tp.tdm.taskpool_addto_nb_tasks(dag.ntasks)
             tp.tdm.ready()
             tp._compiled_dag = dag
-            if self.comm_engine is not None:
+            if self.comm_engine is not None and not local_only:
                 self.comm_engine.taskpool_registered(tp)
             with self._cond:
                 self._cond.notify_all()   # wake a mid-wait driving thread
@@ -145,7 +156,7 @@ class Context:
             tp.tdm.taskpool_addto_nb_tasks(n)
         startup = tp.startup(self)
         tp.tdm.ready()
-        if self.comm_engine is not None:
+        if self.comm_engine is not None and not local_only:
             self.comm_engine.taskpool_registered(tp)
         if startup:
             schedule_tasks(self._submit_es, list(startup), 0)

@@ -338,8 +338,10 @@ def compile_taskpool_dag(tp, context) -> CompiledDag | None:
     """Compile ``tp`` for the native DAG executor, or None (run dynamic)."""
     if not _params.get("runtime_dag_compile"):
         return None
-    if getattr(context, "nb_ranks", 1) > 1:
-        return None            # multi-rank release goes through remote_dep
+    # multi-rank release goes through remote_dep — but rank-private nested
+    # pools are single-rank by construction and stay eligible
+    if getattr(context, "nb_ranks", 1) > 1 and not tp.local_only:
+        return None
     builders = getattr(tp, "_tc_builders", None)
     if builders is None:
         return None            # only enumerable PTG pools compile

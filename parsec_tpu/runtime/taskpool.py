@@ -40,6 +40,8 @@ class Taskpool:
             self.add_task_class(tc)
         self.on_enqueue: Callable[["Taskpool"], None] | None = None
         self.on_complete: Callable[["Taskpool"], None] | None = None
+        # rank-private pool (nested/recursive): ignores data-affinity ranks
+        self.local_only = False
         self._done = threading.Event()
         self.priority = 0
         _registry.insert(self.taskpool_id, self)

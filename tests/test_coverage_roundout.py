@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from parsec_tpu import ptg
+import parsec_tpu.runtime.dagrun  # noqa: F401  (registers runtime_dag_compile)
 from parsec_tpu.core.params import params
 from parsec_tpu.core.rwlock import RWLock
 from parsec_tpu.data.data import TileType
