@@ -85,7 +85,8 @@ class FlowBuilder:
 
     def input(self, pred: tuple | None = None, data: tuple | None = None,
               guard: Callable | None = None, dtt: Any = None,
-              new: bool = False, null: bool = False) -> "FlowBuilder":
+              new: bool = False, null: bool = False,
+              ranged: bool = False) -> "FlowBuilder":
         """Add an input arrow.
 
         ``pred=(class_name, flow_name, params_fn)`` for a task predecessor;
@@ -94,13 +95,23 @@ class FlowBuilder:
         needs a declared tile type); ``null=True`` for an explicit no-data
         input (JDF ``<- NULL``).  ``params_fn(g, l) -> dict`` binds the
         predecessor's locals; ``key_fn(g, l) -> tuple`` the collection key.
-        """
+        ``ranged=True`` marks a *fan-in* arrow whose ``params_fn`` returns a
+        sequence of predecessor instances, each expected to arrive (the JDF
+        range-input form ``<- ctl T(k, 0 .. NB .. 2)``; CTL joins)."""
         if new and dtt is None and self.dtt is None:
             raise ValueError(
                 f"flow {self.name}: NEW needs a tile type to allocate "
                 f"(pass dtt= on the arrow or declare it on the flow)")
+        if ranged and self.access != CTL:
+            # N producers racing one datum slot is nondeterministic — the
+            # counted fan-in protocol is for control joins only (both
+            # front-ends inherit this check)
+            raise ValueError(
+                f"flow {self.name}: ranged fan-in input on a data flow; "
+                f"range inputs are CTL-only")
         self._deps_in.append(self._tcb._mk_dep(pred, data, guard, dtt,
-                                               new=new, null=null))
+                                               new=new, null=null,
+                                               ranged=ranged))
         if new and dtt is not None and self.dtt is None:
             self.dtt = dtt      # NEW allocates at the flow's declared type
         return self
@@ -127,6 +138,12 @@ class TaskClassBuilder:
         self._affinity: Callable | None = None
         self._priority: Callable | None = None
         self._time_estimate: Callable | None = None
+        # user-defined overrides (jdf.h:185-210) + SIMCOST (parsec.y:635)
+        self._make_key: Callable | None = None
+        self._find_deps: Callable | None = None
+        self._hash_struct: Any = None
+        self._startup: Callable | None = None
+        self._simcost: Callable | None = None
 
     # -- structure ----------------------------------------------------------
     def affinity(self, collection: Any, key_fn: Callable) -> "TaskClassBuilder":
@@ -151,6 +168,44 @@ class TaskClassBuilder:
 
     def time_estimate(self, fn: Callable) -> "TaskClassBuilder":
         self._time_estimate = fn
+        return self
+
+    # -- user-defined overrides (the jdf.h:185-210 UD property family) ------
+    def make_key(self, fn: Callable) -> "TaskClassBuilder":
+        """``make_key_fn``: custom task-key construction, ``fn(g, l) -> key``
+        (any hashable; non-tuples are wrapped by the runtime)."""
+        g_ns = self._ptg._g_ns
+        self._make_key = lambda locals_: fn(g_ns(), _ns(locals_))
+        return self
+
+    def find_deps(self, fn: Callable) -> "TaskClassBuilder":
+        """``find_deps_fn``: custom dep-storage location,
+        ``fn(taskpool, g, l) -> hashable identity``."""
+        g_ns = self._ptg._g_ns
+        self._find_deps = lambda tp, locals_: fn(tp, g_ns(), _ns(locals_))
+        return self
+
+    def hash_struct(self, key_hash: Callable | None = None,
+                    key_equal: Callable | None = None,
+                    key_print: Callable | None = None) -> "TaskClassBuilder":
+        """``hash_struct``: user key hashing/equality/printing over the raw
+        key tuples (``parsec_key_fn_t`` analog)."""
+        from ..runtime.task import KeyHashStruct
+        self._hash_struct = KeyHashStruct(key_hash, key_equal, key_print)
+        return self
+
+    def startup(self, fn: Callable) -> "TaskClassBuilder":
+        """``startup_fn``: custom startup enumeration for this class,
+        ``fn(taskpool, context, g) -> iterable of locals dicts`` naming the
+        initially-ready instances (replacing the empty-IN-mask scan)."""
+        self._startup = fn
+        return self
+
+    def simcost(self, fn: Callable) -> "TaskClassBuilder":
+        """``SIMCOST``: simulated execution cost ``fn(g, l) -> float``; the
+        pool then tracks ``largest_simulation_date`` (PARSEC_SIM model)."""
+        g_ns = self._ptg._g_ns
+        self._simcost = lambda locals_: fn(g_ns(), _ns(locals_))
         return self
 
     def body(self, fn: Callable | None = None, device: str = "cpu",
@@ -193,7 +248,8 @@ class TaskClassBuilder:
     # -- helpers ------------------------------------------------------------
     def _mk_dep(self, ref: tuple | None, data: tuple | None,
                 guard: Callable | None, dtt: Any,
-                new: bool = False, null: bool = False) -> Dep:
+                new: bool = False, null: bool = False,
+                ranged: bool = False) -> Dep:
         g_ns = self._ptg._g_ns
         gfn = None
         if guard is not None:
@@ -207,7 +263,8 @@ class TaskClassBuilder:
             cls_name, flow_name, params_fn = ref
             tparams = lambda locals_: params_fn(g_ns(), _ns(locals_))
             return Dep(guard=gfn, target_class=cls_name,
-                       target_flow=flow_name, target_params=tparams, dtt=dtt)
+                       target_flow=flow_name, target_params=tparams, dtt=dtt,
+                       ranged=ranged)
         if data is not None:
             collection, key_fn = data
             dc_get = self._ptg._dc_getter(collection)
@@ -248,6 +305,11 @@ class TaskClassBuilder:
             affinity=self._affinity,
             priority=self._priority,
             time_estimate=self._time_estimate,
+            make_key_fn=self._make_key,
+            find_deps_fn=self._find_deps,
+            hash_struct=self._hash_struct,
+            startup_fn=self._startup,
+            simcost=self._simcost,
         )
 
 
@@ -259,9 +321,19 @@ class PTGTaskpool(Taskpool):
         self._builder = builder
         self._tc_builders: dict[str, TaskClassBuilder] = {}
 
+    @property
+    def globals(self) -> Any:
+        """The bound JDF/builder globals as a namespace — what generated
+        code reaches through ``__parsec_tp->super._g_<name>``; UD override
+        functions receive the pool and read problem sizes through this."""
+        return self._builder._g_ns()
+
     def nb_local_tasks(self) -> int:
         """Count tasks whose affinity lands on this rank (generated
-        ``nb_local_tasks_fn`` analog)."""
+        ``nb_local_tasks_fn`` analog); a pool-level UD override replaces
+        the scan entirely."""
+        if self._builder._nb_local_tasks_fn is not None:
+            return int(self._builder._nb_local_tasks_fn(self))
         my_rank = self.context.my_rank if self.context else 0
         multi = (self.context is not None and self.context.nb_ranks > 1
                  and not self.local_only)
@@ -286,9 +358,14 @@ class PTGTaskpool(Taskpool):
         out = []
         for tc in self.task_classes:
             tcb = self._tc_builders[tc.name]
-            for locals_ in tcb._enumerate_space():
-                if tc.input_dep_mask(locals_) != 0:
-                    continue
+            if tc.startup_fn is not None:
+                # UD startup (JDF_PROP_UD_STARTUP_TASKS_FN_NAME): the user
+                # enumerates the initially-ready instances themselves
+                space = tc.startup_fn(self, context, tcb._ptg._g_ns())
+            else:
+                space = (l for l in tcb._enumerate_space()
+                         if tc.input_dep_mask(l) == 0)
+            for locals_ in space:
                 if multi and tc.affinity is not None:
                     dc, key = tc.affinity(locals_)
                     if not isinstance(key, tuple):
@@ -296,7 +373,7 @@ class PTGTaskpool(Taskpool):
                     if dc.rank_of(*key) != my_rank_of(context):
                         continue
                 prio = tc.priority(locals_) if tc.priority else 0
-                t = Task(self, tc, locals_, priority=prio)
+                t = Task(self, tc, dict(locals_), priority=prio)
                 t.status = "ready"
                 resolve_data_inputs(t)  # snapshot collection reads now
                 out.append(t)
@@ -319,9 +396,23 @@ class PTGBuilder:
         self.globals = dict(globals_)
         self._classes: list[TaskClassBuilder] = []
         self._g_view = _DictNS(self.globals)
+        self._nb_local_tasks_fn: Callable | None = None
+        self._termdet: str | None = None
 
     def global_(self, **kw) -> "PTGBuilder":
         self.globals.update(kw)
+        return self
+
+    def option(self, nb_local_tasks_fn: Callable | None = None,
+               termdet: str | None = None) -> "PTGBuilder":
+        """Pool-level UD options (JDF ``%option`` analog):
+        ``nb_local_tasks_fn(taskpool) -> int`` replaces the execution-space
+        scan (``JDF_PROP_UD_NB_LOCAL_TASKS_FN_NAME``); ``termdet`` selects
+        this pool's termination detector (``JDF_PROP_TERMDET_NAME``)."""
+        if nb_local_tasks_fn is not None:
+            self._nb_local_tasks_fn = nb_local_tasks_fn
+        if termdet is not None:
+            self._termdet = termdet
         return self
 
     def _g_ns(self) -> _DictNS:
@@ -339,6 +430,7 @@ class PTGBuilder:
 
     def build(self) -> PTGTaskpool:
         tp = PTGTaskpool(self.name, self)
+        tp.termdet_name = self._termdet
         for tcb in self._classes:
             tc = tp.add_task_class(tcb._build())
             tp._tc_builders[tc.name] = tcb

@@ -161,9 +161,10 @@ class _FlowDecl:
 
 class _TaskDecl:
     __slots__ = ("name", "params", "ranges", "affinity_src", "flows",
-                 "priority_src", "bodies", "line")
+                 "priority_src", "bodies", "line", "props", "simcost_src",
+                 "derived")
 
-    def __init__(self, name, params, line) -> None:
+    def __init__(self, name, params, line, props=None) -> None:
         self.name = name
         self.params = params
         self.ranges: dict[str, tuple[str, str, str | None]] = {}
@@ -172,6 +173,13 @@ class _TaskDecl:
         self.priority_src: str | None = None
         self.bodies: list[tuple[dict, str]] = []          # (props, code)
         self.line = line
+        self.props = props or {}        # UD overrides (jdf.h:185-210)
+        self.simcost_src: str | None = None
+        # derived locals (`m = t % lmt` lines whose name is not a param,
+        # cf. jdf_variable_list entries without a param): evaluated in
+        # declaration order on top of the bound params, visible to
+        # affinity/guards/arrow args/priority/bodies
+        self.derived: dict[str, str] = {}
 
 
 class JDF:
@@ -183,6 +191,7 @@ class JDF:
         self.prologue_src: list[str] = []
         self.globals_decl: dict[str, dict] = {}   # name -> props
         self.tasks: dict[str, _TaskDecl] = {}
+        self.options: dict[str, str] = {}         # %option lines
 
     # -- build ---------------------------------------------------------------
     def build(self, **bindings: Any) -> PTGTaskpool:
@@ -190,6 +199,7 @@ class JDF:
         for src in self.prologue_src:
             exec(compile(src, f"<jdf:{self.name}:prologue>", "exec"), ns)
         ns.pop("__builtins__", None)
+        self._last_ns = ns    # introspection: tests/tools peek at prologue state
 
         for gname, props in self.globals_decl.items():
             if gname in bindings:
@@ -207,6 +217,25 @@ class JDF:
 
         self._sanity_check()
         builder = PTGBuilder(self.name, **bindings)
+
+        def resolve(pname: str, val: str, line: int) -> Any:
+            """Look a UD property value up in the prologue/bindings."""
+            env = dict(ns)
+            env.update(bindings)
+            if val not in env:
+                raise JDFError(
+                    f"line {line}: [{pname} = {val}] does not name a "
+                    f"prologue or build() binding")
+            return env[val]
+
+        # pool-level %option lines (jdf.h UD pool properties)
+        for oname, oval in self.options.items():
+            if oname == "nb_local_tasks_fn":
+                builder.option(nb_local_tasks_fn=resolve(oname, oval, 0))
+            elif oname == "termdet":
+                builder.option(termdet=oval)
+            else:
+                raise JDFError(f"unknown %option '{oname}'")
 
         def expr(src: str) -> Callable:
             code = compile(src.strip(), f"<jdf:{self.name}>", "eval")
@@ -227,28 +256,80 @@ class JDF:
                 params[p] = _mk_range(expr(lo), expr(hi),
                                       expr(step) if step else None)
             tcb = builder.task(td.name, **params)
+
+            # derived locals layer on top of the bound params: every
+            # expression of this task evaluates them (in order) first
+            dcodes = [(dn, compile(src.strip(),
+                                   f"<jdf:{self.name}:{td.name}:{dn}>",
+                                   "eval"))
+                      for dn, src in td.derived.items()]
+
+            def texpr(src: str, _dc=dcodes) -> Callable:
+                code = compile(src.strip(), f"<jdf:{self.name}>", "eval")
+
+                def fn(g, l):
+                    env = dict(ns)
+                    env.update(vars(g))
+                    env.update(vars(l))
+                    for dn, c in _dc:
+                        env[dn] = eval(c, env)
+                    return eval(code, env)
+                return fn
+
             if td.affinity_src is not None:
                 coll, args = td.affinity_src
-                key_fn = _mk_key(expr, args)
+                key_fn = _mk_key(texpr, args)
                 tcb.affinity(coll, key_fn)
             if td.priority_src is not None:
-                tcb.priority(expr(td.priority_src))
+                tcb.priority(texpr(td.priority_src))
+            if td.simcost_src is not None:
+                tcb.simcost(texpr(td.simcost_src))
+            for pname, pval in td.props.items():
+                fn = resolve(pname, str(pval), td.line)
+                if pname == "make_key_fn":
+                    tcb.make_key(fn)
+                elif pname == "find_deps_fn":
+                    tcb.find_deps(fn)
+                elif pname == "startup_fn":
+                    tcb.startup(fn)
+                elif pname == "hash_struct":
+                    from ..runtime.task import KeyHashStruct
+                    if isinstance(fn, KeyHashStruct):
+                        tcb._hash_struct = fn
+                    elif isinstance(fn, dict):
+                        tcb.hash_struct(**fn)
+                    else:
+                        raise JDFError(
+                            f"line {td.line}: hash_struct must name a "
+                            f"KeyHashStruct or a dict of key_* callables")
+                else:
+                    raise JDFError(
+                        f"line {td.line}: unknown task property "
+                        f"'{pname}'")
             typeenv = dict(ns)
             typeenv.update(bindings)
             for fd in td.flows:
                 fb = tcb.flow(fd.name, fd.access)
                 for ar in fd.arrows:
-                    self._attach_arrow(fb, ar, fd, td, expr, typeenv)
+                    self._attach_arrow(fb, ar, fd, td, texpr, typeenv)
             for props, code_str in td.bodies:
                 btype = props.get("type", "python")
+                evaluate = None
+                if "evaluate" in props:
+                    # BODY [evaluate = fn]: chore-selection hook from the
+                    # prologue, (es, task) -> HOOK_RETURN_* (jdf.h
+                    # JDF_BODY_PROP_EVALUATE)
+                    evaluate = resolve("evaluate", str(props["evaluate"]),
+                                       td.line)
                 if btype in ("python", "cpu"):
-                    tcb.body(_mk_body(code_str, ns, td.name))
+                    tcb.body(_mk_body(code_str, ns, td.name, dcodes),
+                             evaluate=evaluate)
                 else:
                     dyld = props.get("dyld")
                     if not dyld:
                         raise JDFError(
                             f"{td.name}: device BODY needs dyld = <kernel>")
-                    tcb.body(device=btype, dyld=dyld)
+                    tcb.body(device=btype, dyld=dyld, evaluate=evaluate)
         return builder.build()
 
     # -- arrows --------------------------------------------------------------
@@ -300,15 +381,50 @@ class JDF:
                     raise JDFError(
                         f"line {ar.line}: {name}() takes "
                         f"{len(t_decl.params)} params, got {len(args)}")
-                arg_fns = [expr(a) for a in args]
+                # range args (`0 .. NB .. 2`): the arrow fans out (output)
+                # or joins N arrivals (input; CTL only)
+                arg_fns: list = []
+                any_rng = False
+                for a in args:
+                    parts = [p.strip() for p in a.split("..")]
+                    if len(parts) == 1:
+                        arg_fns.append((expr(a), None, None))
+                    elif len(parts) in (2, 3):
+                        any_rng = True
+                        arg_fns.append(
+                            (expr(parts[0]), expr(parts[1]),
+                             expr(parts[2]) if len(parts) == 3 else None))
+                    else:
+                        raise JDFError(
+                            f"line {ar.line}: bad range argument {a!r}")
                 pnames = list(t_decl.params)
 
-                def params_fn(g, l, _fns=arg_fns, _ps=pnames):
-                    return {p: fn(g, l) for p, fn in zip(_ps, _fns)}
+                def params_fn(g, l, _fns=arg_fns, _ps=pnames,
+                              _rng=any_rng):
+                    import itertools as _it
+                    axes = []
+                    for lo_fn, hi_fn, step_fn in _fns:
+                        if hi_fn is None:
+                            axes.append((lo_fn(g, l),))
+                        else:
+                            step = int(step_fn(g, l)) if step_fn else 1
+                            axes.append(range(
+                                int(lo_fn(g, l)),
+                                int(hi_fn(g, l)) + (1 if step > 0 else -1),
+                                step))
+                    if not _rng:
+                        return {p: v[0] for p, v in zip(_ps, axes)}
+                    return tuple(dict(zip(_ps, combo))
+                                 for combo in _it.product(*axes))
 
                 ref = (name, flow, params_fn)
                 if ar.direction == "in":
-                    fb.input(pred=ref, guard=gfn, dtt=dtt)
+                    if any_rng and fd.access != CTL:
+                        raise JDFError(
+                            f"line {ar.line}: range input on data flow "
+                            f"{fd.name} — N producers for one datum is "
+                            f"nondeterministic; range fan-in is CTL-only")
+                    fb.input(pred=ref, guard=gfn, dtt=dtt, ranged=any_rng)
                 else:
                     fb.output(succ=ref, guard=gfn, dtt=dtt)
             else:   # data
@@ -396,13 +512,16 @@ def _mk_key(expr, args_src: str):
     return key_fn
 
 
-def _mk_body(code_str: str, prologue_ns: dict, tname: str):
+def _mk_body(code_str: str, prologue_ns: dict, tname: str,
+             derived_codes: list | None = None):
     code = compile(_dedent(code_str), f"<jdf:{tname}:body>", "exec")
 
     def body(es, task, g, l):
         env = dict(prologue_ns)
         env.update(vars(g))
         env.update(vars(l))
+        for dn, c in derived_codes or ():
+            env[dn] = eval(c, env)
         env["es"], env["task"] = es, task
         before = {}
         for f in task.task_class.flows:
@@ -433,7 +552,8 @@ def _dedent(code: str) -> str:
 
 _RE_GLOBAL = re.compile(r"^(\w+)\s*(?:=\s*(?P<default>[^\[]+?))?\s*"
                         r"(?:\[(?P<props>[^\]]*)\])?\s*$")
-_RE_TASK = re.compile(r"^(\w+)\s*\(([\w\s,]*)\)\s*$")
+_RE_TASK = re.compile(r"^(\w+)\s*\(([\w\s,]*)\)\s*"
+                      r"(?:\[(?P<props>[^\]]*)\])?\s*$")
 _RE_RANGE = re.compile(r"^(\w+)\s*=\s*(.+)$")
 _RE_FLOW = re.compile(r"^(RW|READ|WRITE|CTL)\s+(\w+)\s*(.*)$")
 _RE_TARGET_TASK = re.compile(r"^(\w+)\s+(\w+)\s*\((.*)\)$")
@@ -453,6 +573,13 @@ def _parse_props(s: str | None) -> dict:
         else:
             out[m.group(3)] = True
     return out
+
+
+def load_jdf(path: Any, name: str | None = None) -> JDF:
+    """Parse a ``.jdf`` file from disk (the ``parsec_ptgpp <file>`` entry)."""
+    import pathlib
+    p = pathlib.Path(path)
+    return parse_jdf(p.read_text(), name or p.stem)
 
 
 def parse_jdf(text: str, name: str = "jdf") -> JDF:
@@ -482,7 +609,12 @@ def parse_jdf(text: str, name: str = "jdf") -> JDF:
             continue
 
         if line.startswith("%"):
-            i += 1          # %option etc.: accepted and ignored
+            # %option name = value (pool-level UD properties); other
+            # %-directives are accepted and ignored
+            if line.startswith("%option"):
+                jdf.options.update(
+                    {k: v for k, v in _parse_props(line[7:]).items()})
+            i += 1
             continue
 
         if _RE_BODY_KW.match(line):
@@ -507,7 +639,8 @@ def parse_jdf(text: str, name: str = "jdf") -> JDF:
             cur = _TaskDecl(
                 m.group(1),
                 [p.strip() for p in m.group(2).split(",") if p.strip()],
-                i + 1)
+                i + 1,
+                props=_parse_props(m.group("props")))
             if cur.name in jdf.tasks:
                 err(f"duplicate task class {cur.name}")
             jdf.tasks[cur.name] = cur
@@ -542,6 +675,15 @@ def parse_jdf(text: str, name: str = "jdf") -> JDF:
             i += 1
             continue
 
+        if line.startswith("SIMCOST"):
+            # simulation-cost expression (parsec.y:635-641, PARSEC_SIM)
+            cur.simcost_src = line[len("SIMCOST"):].strip()
+            if not cur.simcost_src:
+                err("SIMCOST needs an expression")
+            cur_flow = None
+            i += 1
+            continue
+
         if line.startswith("<-") or line.startswith("->"):
             if cur_flow is None:
                 err("dependency arrow outside a flow declaration")
@@ -571,6 +713,14 @@ def parse_jdf(text: str, name: str = "jdf") -> JDF:
                 cur.ranges[mr.group(1)] = (parts[0], parts[1], parts[2])
             else:
                 err(f"bad range: {line!r}")
+            cur_flow = None
+            i += 1
+            continue
+
+        if mr and ".." not in mr.group(2):
+            # derived local: name = expr (the stencil's `m = t % lmt`,
+            # Ex05's `loc = k + n` form)
+            cur.derived[mr.group(1)] = mr.group(2).strip()
             cur_flow = None
             i += 1
             continue

@@ -8,7 +8,7 @@ from .scheduling import (ExecutionStream, VirtualProcess, complete_execution,
 from .task import (DEV_CPU, DEV_RECURSIVE, DEV_TPU, FLOW_CTL,
                    HOOK_RETURN_AGAIN, HOOK_RETURN_ASYNC, HOOK_RETURN_DISABLE,
                    HOOK_RETURN_DONE, HOOK_RETURN_ERROR, HOOK_RETURN_NEXT,
-                   Chore, Dep, Flow, Task, TaskClass)
+                   Chore, Dep, Flow, KeyHashStruct, Task, TaskClass, UDKey)
 from .recursive import recursive_call
 from .taskpool import CompoundTaskpool, Taskpool, compose, taskpool_lookup
 from .termdet import (LocalTermDet, TermDetMonitor, UserTriggerTermDet)
@@ -18,7 +18,8 @@ __all__ = [
     "DEV_TPU", "Dep", "DependencyTracking", "ExecutionStream", "FLOW_CTL",
     "Flow", "HOOK_RETURN_AGAIN", "HOOK_RETURN_ASYNC", "HOOK_RETURN_DISABLE",
     "HOOK_RETURN_DONE", "HOOK_RETURN_ERROR", "HOOK_RETURN_NEXT",
-    "LocalTermDet", "Task", "TaskClass", "Taskpool", "TermDetMonitor",
+    "KeyHashStruct", "LocalTermDet", "Task", "TaskClass", "Taskpool",
+    "TermDetMonitor", "UDKey",
     "UserTriggerTermDet", "VirtualProcess", "complete_execution", "compose",
     "execute_task", "prepare_input", "release_deps", "recursive_call",
     "schedule_tasks", "select_task", "task_progress", "taskpool_lookup",

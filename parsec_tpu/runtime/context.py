@@ -121,8 +121,10 @@ class Context:
         tp.local_only = local_only = tp.local_only or local_only
         pins.fire(PinsEvent.TASKPOOL_INIT, None, tp)
         if tp.tdm is None:
+            # precedence: rank-private forces local > per-pool selection
+            # (JDF_PROP_TERMDET_NAME) > MCA param > local
             name = "local" if local_only else \
-                (_params.get("termdet") or "local")
+                (tp.termdet_name or _params.get("termdet") or "local")
             tp.tdm = repository.query("termdet", requested=name).open(self)
         tp.tdm.monitor_taskpool(tp, tp.terminated)
         with self._lock:

@@ -41,9 +41,22 @@ def _pack_key64(tpid: int, tcid: int, key: tuple) -> int | None:
     return (tpid << (_TC_BITS + _PARAM_BITS)) | (tcid << _PARAM_BITS) | v
 
 
+def _tracker_key(taskpool: Any, tc: "TaskClass", locals_: dict,
+                 tkey: tuple) -> tuple:
+    """Where a task's dep tracker lives — shared by mask and counted modes.
+
+    A user ``find_deps_fn`` (JDF_PROP_UD_FIND_DEPS_FN_NAME) answers the
+    location question itself (any hashable identity); the tracker store/GC
+    stays the runtime's (the alloc/free_deps_fn halves are runtime-owned).
+    """
+    if tc.find_deps_fn is not None:
+        return (taskpool.taskpool_id, tc.find_deps_fn(taskpool, locals_))
+    return (taskpool.taskpool_id, tc.task_class_id, tkey)
+
+
 class _DepTracker:
     __slots__ = ("required_mask", "satisfied_mask", "inputs", "repo_refs",
-                 "priority")
+                 "priority", "goal")
 
     def __init__(self, required_mask: int, nflows: int) -> None:
         self.required_mask = required_mask
@@ -51,6 +64,7 @@ class _DepTracker:
         self.inputs: list[Any] = [None] * nflows
         self.repo_refs: list[Any] = [None] * nflows
         self.priority = 0
+        self.goal = -1   # >= 0: counted mode (ranged deps), arrivals left
 
 
 class DependencyTracking:
@@ -86,14 +100,21 @@ class DependencyTracking:
         completion (``jdf2c.c:7157`` consume-input-repos contract).
         """
         tkey = tc.make_key(locals_)
+        if tc.counted:
+            # goal-counted mode (ranged input deps): arrivals decrement a
+            # per-task counter instead of OR-ing bits — N arrivals may land
+            # on ONE declared dep (the dependencies_goal protocol)
+            return self._release_counted(taskpool, tc, locals_, tkey,
+                                         flow_index, data_copy, repo_ref)
         bit = 1 << tc.dep_bit(flow_index, dep_index)
-        if self._native is not None:
+        if self._native is not None and tc.find_deps_fn is None:
+            # UD keys with non-int elements refuse to pack and fall through
             k64 = _pack_key64(taskpool.taskpool_id, tc.task_class_id, tkey)
             if k64 is not None:
                 return self._release_native(taskpool, tc, locals_, tkey, k64,
                                             bit, flow_index, data_copy,
                                             repo_ref)
-        key = (taskpool.taskpool_id, tc.task_class_id, tkey)
+        key = _tracker_key(taskpool, tc, locals_, tkey)
         with self._table.locked(key):
             trk = self._table.get(key)
             if trk is None:
@@ -107,6 +128,30 @@ class DependencyTracking:
                 trk.inputs[flow_index] = data_copy
                 trk.repo_refs[flow_index] = repo_ref
             ready = trk.satisfied_mask == trk.required_mask
+            if ready:
+                self._table.remove(key)
+        if not ready:
+            return None
+        return self._make_ready(taskpool, tc, locals_, trk.inputs,
+                                trk.repo_refs)
+
+    def _release_counted(self, taskpool: Any, tc: TaskClass, locals_: dict,
+                         tkey: tuple, flow_index: int, data_copy: Any,
+                         repo_ref: Any) -> Task | None:
+        key = _tracker_key(taskpool, tc, locals_, tkey)
+        with self._table.locked(key):
+            trk = self._table.get(key)
+            if trk is None:
+                trk = _DepTracker(0, len(tc.flows))
+                trk.goal = tc.input_dep_goal(locals_)
+                self._table.insert(key, trk)
+            assert trk.goal > 0, \
+                f"dep {tc.name}{tkey}: more arrivals than the goal"
+            trk.goal -= 1
+            if data_copy is not None:
+                trk.inputs[flow_index] = data_copy
+                trk.repo_refs[flow_index] = repo_ref
+            ready = trk.goal == 0
             if ready:
                 self._table.remove(key)
         if not ready:

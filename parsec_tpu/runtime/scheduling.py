@@ -246,6 +246,15 @@ def complete_execution(es: ExecutionStream, task: Task) -> None:
     tp = task.taskpool
     if tc.complete_execution is not None:
         tc.complete_execution(es, task)
+    if tp.sim_enabled:
+        # PARSEC_SIM cost model: exec date = latest predecessor date +
+        # this task's simulated cost; the pool tracks the critical path
+        with tp._sim_lock:
+            start = tp._sim_ready.pop((tc.name, task.key), 0.0)
+            task.sim_exec_date = start + (
+                float(tc.simcost(task.locals)) if tc.simcost else 0.0)
+            if task.sim_exec_date > tp.largest_simulation_date:
+                tp.largest_simulation_date = task.sim_exec_date
     release_deps(es, task)
     # consume the input repo entries (GC protocol, jdf2c.c:7157)
     for ref in task.repo_entries:
@@ -294,6 +303,15 @@ def release_deps(es: ExecutionStream, task: Task) -> None:
                 remote = ctx.remote_dep_accumulate(remote, t, flow, dep,
                                                    succ_tc, succ_locals, rank)
                 continue
+            if tp.sim_enabled:
+                # PARSEC_SIM dates are rank-local (the reference's SIM mode
+                # is a shared-memory build): only successors that will
+                # execute here record a ready date — a remote entry would
+                # never be popped and the date would never ship anyway
+                skey = (succ_tc.name, succ_tc.make_key(succ_locals))
+                with tp._sim_lock:
+                    if t.sim_exec_date > tp._sim_ready.get(skey, 0.0):
+                        tp._sim_ready[skey] = t.sim_exec_date
             fi, di = _find_input_dep(succ_tc, dep.target_flow, tc.name,
                                      succ_locals)
             repo_ref = None

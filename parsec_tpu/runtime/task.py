@@ -56,7 +56,7 @@ class Dep:
     """
 
     __slots__ = ("guard", "target_class", "target_flow", "target_params",
-                 "dtt", "data_ref", "null")
+                 "dtt", "data_ref", "null", "ranged")
 
     def __init__(self, guard: Callable[[dict], bool] | None = None,
                  target_class: str | None = None,
@@ -64,7 +64,7 @@ class Dep:
                  target_params: Callable[[dict], tuple] | None = None,
                  dtt: Any = None,
                  data_ref: Callable[[dict], tuple] | None = None,
-                 null: bool = False) -> None:
+                 null: bool = False, ranged: bool = False) -> None:
         self.guard = guard
         self.target_class = target_class
         self.target_flow = target_flow
@@ -72,6 +72,10 @@ class Dep:
         self.dtt = dtt
         self.data_ref = data_ref  # (collection, key...) accessor for dc edges
         self.null = null
+        # ranged INPUT dep (JDF `<- ctl T(k, 0 .. NB .. 2)`): one declared
+        # dep expecting len(each_target) arrivals — the class switches from
+        # mask to goal-counted dep tracking (dependencies_goal protocol)
+        self.ranged = ranged
 
     def active(self, locals_: dict) -> bool:
         return self.guard is None or bool(self.guard(locals_))
@@ -123,6 +127,50 @@ class Chore:
         self.enabled = True
 
 
+class KeyHashStruct:
+    """User-defined key semantics (cf. ``parsec_key_fn_t`` and the JDF
+    ``hash_struct`` property, ``jdf.h:189-190``): ``key_hash(key) -> int``,
+    ``key_equal(a, b) -> bool``, ``key_print(key) -> str``.  Installed on a
+    task class it governs how that class's task keys hash/compare in the
+    dep-tracking and repo hash tables (via :class:`UDKey`)."""
+
+    __slots__ = ("key_hash", "key_equal", "key_print")
+
+    def __init__(self, key_hash: Callable[[Any], int] | None = None,
+                 key_equal: Callable[[Any, Any], bool] | None = None,
+                 key_print: Callable[[Any], str] | None = None) -> None:
+        self.key_hash = key_hash
+        self.key_equal = key_equal
+        self.key_print = key_print
+
+
+class UDKey:
+    """A task key carrying a :class:`KeyHashStruct`: Python hash tables
+    (the tracker/repo stores) call straight into the user's hash/equal."""
+
+    __slots__ = ("key", "hs")
+
+    def __init__(self, key: tuple, hs: KeyHashStruct) -> None:
+        self.key = key
+        self.hs = hs
+
+    def __hash__(self) -> int:
+        if self.hs.key_hash is not None:
+            return int(self.hs.key_hash(self.key))
+        return hash(self.key)
+
+    def __eq__(self, other: Any) -> bool:
+        ok = other.key if isinstance(other, UDKey) else other
+        if self.hs.key_equal is not None:
+            return bool(self.hs.key_equal(self.key, ok))
+        return self.key == ok
+
+    def __repr__(self) -> str:
+        if self.hs.key_print is not None:
+            return self.hs.key_print(self.key)
+        return repr(self.key)
+
+
 class TaskClass:
     """Static description of one task kind (cf. ``parsec_task_class_t``)."""
 
@@ -133,7 +181,12 @@ class TaskClass:
                  priority: Callable[[dict], int] | None = None,
                  time_estimate: Callable[[Any, Any], float] | None = None,
                  prepare_input: Callable | None = None,
-                 complete_execution: Callable | None = None) -> None:
+                 complete_execution: Callable | None = None,
+                 make_key_fn: Callable[[dict], Any] | None = None,
+                 find_deps_fn: Callable | None = None,
+                 hash_struct: Any = None,
+                 startup_fn: Callable | None = None,
+                 simcost: Callable[[dict], float] | None = None) -> None:
         self.name = name
         self.params = list(params)
         self.flows = list(flows)
@@ -146,8 +199,20 @@ class TaskClass:
         self.time_estimate = time_estimate
         self.prepare_input = prepare_input
         self.complete_execution = complete_execution
+        # user-defined overrides (jdf.h:185-210): custom key construction,
+        # custom dep-storage location, custom key hashing, custom startup
+        # enumeration, and the PARSEC_SIM cost model (parsec.y:635-641)
+        self.make_key_fn = make_key_fn
+        self.find_deps_fn = find_deps_fn
+        self.hash_struct = hash_struct    # KeyHashStruct or None
+        self.startup_fn = startup_fn
+        self.simcost = simcost
         self.repo = None                  # DataRepo, attached by the taskpool
-        self.dependencies_goal = 0        # unused for guarded classes
+        # counted mode: any ranged input dep means arrivals are *counted*
+        # toward a per-task goal instead of OR-ed into a bitmask (the
+        # reference's dependencies_goal counting vs mask protocol)
+        self.counted = any(d.ranged for f in self.flows for d in f.deps_in)
+        self.dependencies_goal = 0        # static goal unused when guarded
         # make_key on the C path: itemgetter over the param names
         from operator import itemgetter
         if len(self.params) >= 2:
@@ -167,8 +232,20 @@ class TaskClass:
 
     # -- keys ---------------------------------------------------------------
     def make_key(self, locals_: dict) -> tuple:
-        """Canonical task key (cf. generated ``make_key`` fns)."""
-        return self._keyget(locals_)
+        """Canonical task key (cf. generated ``make_key`` fns).
+
+        A user ``make_key_fn`` (``JDF_PROP_UD_MAKE_KEY_FN_NAME``) replaces
+        the positional-params key; non-tuple results are wrapped so every
+        consumer still sees a tuple.  A ``hash_struct`` additionally wraps
+        the key so user ``key_hash``/``key_equal`` drive the hash tables."""
+        if self.make_key_fn is not None:
+            k = self.make_key_fn(locals_)
+            k = k if isinstance(k, tuple) else (k,)
+        else:
+            k = self._keyget(locals_)
+        if self.hash_struct is not None:
+            return (UDKey(k, self.hash_struct),)
+        return k
 
     # -- dep structure ------------------------------------------------------
     def input_dep_mask(self, locals_: dict) -> int:
@@ -179,9 +256,26 @@ class TaskClass:
         for f in self.flows:
             for d in f.deps_in:
                 if d.target_class is not None and d.active(locals_):
-                    mask |= 1 << bit
+                    # an active ranged dep whose range is EMPTY for these
+                    # locals expects zero arrivals: it must not gate
+                    # readiness (keeps the mask consistent with
+                    # input_dep_goal — the dependencies_goal protocol)
+                    if not d.ranged or d.each_target(locals_):
+                        mask |= 1 << bit
                 bit += 1
         return mask
+
+    def input_dep_goal(self, locals_: dict) -> int:
+        """Expected input-arrival count for counted classes: each active
+        task-predecessor dep contributes one arrival per target instance
+        (ranged deps fan in len(each_target) arrivals)."""
+        goal = 0
+        for f in self.flows:
+            for d in f.deps_in:
+                if d.target_class is None or not d.active(locals_):
+                    continue
+                goal += len(d.each_target(locals_)) if d.ranged else 1
+        return goal
 
     def dep_bit(self, flow_index: int, dep_index: int) -> int:
         try:
@@ -210,7 +304,8 @@ class Task:
 
     __slots__ = ("taskpool", "task_class", "locals", "priority", "data",
                  "repo_entries", "status", "chore_mask", "uid",
-                 "selected_device", "_mempool_owner", "on_complete")
+                 "selected_device", "_mempool_owner", "on_complete",
+                 "sim_exec_date")
 
     def __init__(self, taskpool: Any, task_class: TaskClass,
                  locals_: dict, priority: int = 0) -> None:
@@ -227,6 +322,7 @@ class Task:
         self.uid = next(_task_counter)
         self.selected_device = None
         self.on_complete = None
+        self.sim_exec_date = 0.0   # PARSEC_SIM simulated completion date
 
     @property
     def key(self) -> tuple:

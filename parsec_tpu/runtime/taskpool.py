@@ -42,6 +42,15 @@ class Taskpool:
         self.on_complete: Callable[["Taskpool"], None] | None = None
         # rank-private pool (nested/recursive): ignores data-affinity ranks
         self.local_only = False
+        # per-pool termdet selection (JDF_PROP_TERMDET_NAME): overrides the
+        # MCA param for this pool when set ("local", "user_trigger", ...)
+        self.termdet_name: str | None = None
+        # PARSEC_SIM cost model: enabled when any class carries a simcost
+        # expression; tracks the simulated critical path of the pool
+        self.sim_enabled = False
+        self._sim_ready: dict = {}      # (class, key) -> max pred exec date
+        self._sim_lock = threading.Lock()
+        self.largest_simulation_date = 0.0
         self._done = threading.Event()
         self.priority = 0
         _registry.insert(self.taskpool_id, self)
@@ -52,6 +61,8 @@ class Taskpool:
         self.task_classes.append(tc)
         self.task_classes_by_name[tc.name] = tc
         tc.repo = DataRepo(len(tc.flows), name=f"{self.name}.{tc.name}")
+        if tc.simcost is not None:
+            self.sim_enabled = True
         return tc
 
     def task_class(self, name: str) -> TaskClass:

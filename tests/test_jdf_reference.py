@@ -1,0 +1,418 @@
+"""Reference-shaped JDF ingestion: translated .jdf files + UD overrides.
+
+Two suites the VERDICT r3 called for:
+
+1. In-tree mechanical translations of reference JDFs
+   (``tests/apps/stencil/stencil_1D.jdf``, ``examples/Ex05-07``) parsed by
+   the textual front-end and run single- and multi-rank — exercising the
+   grammar features those files need: derived locals, range arrows
+   (fan-out AND counted CTL fan-in), NULL else-branches.
+2. The user-defined override family (``jdf.h:185-210``):
+   ``nb_local_tasks_fn``, ``make_key_fn``, ``find_deps_fn``,
+   ``hash_struct``, ``startup_fn``, per-pool ``termdet``, body
+   ``evaluate``, and ``SIMCOST`` (``parsec.y:635-641``) — mirroring
+   ``tests/dsl/ptg/user-defined-functions/udf.jdf``.
+"""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+from parsec_tpu import ptg
+from parsec_tpu.comm import run_multirank
+from parsec_tpu.data_dist.matrix import TwoDimBlockCyclic, VectorTwoDimCyclic
+from parsec_tpu.models.stencil import stencil_reference
+from parsec_tpu.runtime import Context, LocalTermDet, UserTriggerTermDet
+
+JDF_DIR = pathlib.Path(__file__).resolve().parent.parent / "examples" / "jdf"
+
+
+# ---------------------------------------------------------------------------
+# translated stencil
+# ---------------------------------------------------------------------------
+
+def _stencil_desc(nranks, rank, MB, NB, LMT, LNT, R, seed=0):
+    """LMT x LNT buffer tiles of (MB, NB); interior random, ghosts zero."""
+    rng = np.random.default_rng(seed)
+    interior = rng.standard_normal((MB, LNT * (NB - 2 * R))).astype(np.float32)
+
+    def init(m, n, shape):
+        tile = np.zeros(shape, np.float32)
+        if m == 0:  # generation-0 state lives in buffer row 0
+            w = NB - 2 * R
+            tile[:, R:NB - R] = interior[:, n * w:(n + 1) * w]
+        return tile
+
+    desc = TwoDimBlockCyclic(
+        "descA", lm=LMT * MB, ln=LNT * NB, mb=MB, nb=NB,
+        P=1, Q=nranks, myrank=rank, init_fn=init)
+    return desc, interior
+
+
+def _stencil_oracle(interior, W, iters):
+    return np.stack([stencil_reference(row, np.asarray(W, np.float64), iters)
+                     for row in interior])
+
+
+def _gather_interior(desc, MB, NB, LNT, R, t, LMT):
+    m = t % LMT
+    cols = []
+    for n in range(LNT):
+        tile = np.asarray(desc.data_of(m, n).newest_copy().value)
+        cols.append(tile[:, R:NB - R])
+    return np.concatenate(cols, axis=1)
+
+
+def test_translated_stencil_single_rank():
+    MB, NB, LMT, LNT, R, iters = 3, 8, 2, 4, 2, 5
+    desc, interior = _stencil_desc(1, 0, MB, NB, LMT, LNT, R)
+    W = np.array([0.05, 0.2, 0.5, 0.2, 0.05])
+    jdf = ptg.load_jdf(JDF_DIR / "stencil_1D.jdf")
+    tp = jdf.build(descA=desc, iter=iters, R=R, W=W, LMT=LMT, LNT=LNT)
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+    got = _gather_interior(desc, MB, NB, LNT, R, iters, LMT)
+    want = _stencil_oracle(interior, W, iters)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_translated_stencil_matches_programmatic():
+    """The translated reference JDF and the repo's programmatic stencil
+    produce the same trajectory (1-row tiles -> identical 1-D problem)."""
+    from parsec_tpu.models.stencil import stencil_1d_ptg
+    MB, R, iters = 1, 1, 4
+    NB, LMT, LNT = 6, 2, 3
+    desc, interior = _stencil_desc(1, 0, MB, NB, LMT, LNT, R, seed=3)
+    W = np.array([0.25, 0.5, 0.25])
+    jdf = ptg.load_jdf(JDF_DIR / "stencil_1D.jdf")
+    tp = jdf.build(descA=desc, iter=iters, R=R, W=W, LMT=LMT, LNT=LNT)
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+    got = _gather_interior(desc, MB, NB, LNT, R, iters, LMT)[0]
+
+    n = interior.shape[1]
+    V = VectorTwoDimCyclic("V", lm=n, mb=NB - 2 * R, P=1,
+                           init_fn=lambda m, size:
+                           interior[0, m * (NB - 2 * R):
+                                    m * (NB - 2 * R) + size])
+    tp2 = stencil_1d_ptg(V, W, iters)
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp2)
+        ctx.wait(timeout=120)
+    prog = np.concatenate([
+        np.asarray(V.data_of(i).newest_copy().value) for i in range(V.mt)])
+    np.testing.assert_allclose(got, prog, rtol=1e-4, atol=1e-5)
+
+
+def _stencil_rank_body(ctx, rank, nranks):
+    MB, NB, LMT, LNT, R, iters = 2, 8, 2, 8, 2, 4
+    desc, interior = _stencil_desc(nranks, rank, MB, NB, LMT, LNT, R, seed=1)
+    W = np.array([0.1, 0.2, 0.4, 0.2, 0.1])
+    jdf = ptg.load_jdf(JDF_DIR / "stencil_1D.jdf")
+    tp = jdf.build(descA=desc, iter=iters, R=R, W=W, LMT=LMT, LNT=LNT)
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=180)
+    ctx.comm_barrier()
+    want = _stencil_oracle(interior, W, iters)
+    m = iters % LMT
+    w = NB - 2 * R
+    for n in range(LNT):
+        if desc.rank_of(m, n) != rank:
+            continue
+        tile = np.asarray(desc.data_of(m, n).newest_copy().value)
+        np.testing.assert_allclose(tile[:, R:NB - R],
+                                   want[:, n * w:(n + 1) * w],
+                                   rtol=1e-4, atol=1e-5)
+    return True
+
+
+@pytest.mark.parametrize("nranks", [4, 8])
+def test_translated_stencil_multirank(nranks):
+    assert all(run_multirank(nranks, _stencil_rank_body))
+
+
+# ---------------------------------------------------------------------------
+# translated Ex05-07
+# ---------------------------------------------------------------------------
+
+def _mydata(nranks, rank, nodes, NB=6):
+    return VectorTwoDimCyclic("mydata", lm=nodes + NB + 1, mb=1,
+                              P=nranks, myrank=rank, dtype=np.int32,
+                              init_fn=lambda m, size: np.zeros(size,
+                                                               np.int32))
+
+
+def _ex_rank_body_factory(fname, check):
+    def body(ctx, rank, nranks):
+        nodes = nranks
+        md = _mydata(nranks, rank, nodes)
+        jdf = ptg.load_jdf(JDF_DIR / fname)
+        tp = jdf.build(mydata=md, nodes=nodes)
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+        ctx.comm_barrier()
+        return check(md, rank, nranks)
+    return body
+
+
+def _check_ex05(md, rank, nranks):
+    return True   # the Recv assertions inside the bodies are the test
+
+
+def _check_ex0607(md, rank, nranks):
+    for k in range(nranks):
+        if md.rank_of(k) == rank:
+            v = int(np.asarray(md.data_of(k).newest_copy().value)[0])
+            assert v == -k - 1, (k, v)
+    return True
+
+
+def test_ex05_broadcast_single_rank():
+    md = _mydata(1, 0, nodes=3)
+    jdf = ptg.load_jdf(JDF_DIR / "Ex05_Broadcast.jdf")
+    tp = jdf.build(mydata=md, nodes=3)
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+
+
+@pytest.mark.parametrize("fname,check", [
+    ("Ex05_Broadcast.jdf", _check_ex05),
+    ("Ex06_RAW.jdf", _check_ex0607),
+    ("Ex07_RAW_CTL.jdf", _check_ex0607),
+])
+def test_ex_multirank(fname, check):
+    assert all(run_multirank(4, _ex_rank_body_factory(fname, check)))
+
+
+def test_ex07_ctl_join_single_rank():
+    """The counted CTL fan-in: with the join in place every Recv observes
+    the pre-update value even single-rank multi-worker."""
+    md = _mydata(1, 0, nodes=4)
+    jdf = ptg.load_jdf(JDF_DIR / "Ex07_RAW_CTL.jdf")
+    tp = jdf.build(mydata=md, nodes=4)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+    for k in range(4):
+        assert int(np.asarray(md.data_of(k).newest_copy().value)[0]) == -k - 1
+
+
+# ---------------------------------------------------------------------------
+# UD overrides (udf.jdf mirror)
+# ---------------------------------------------------------------------------
+
+_UD_JDF = """
+%{
+calls = {"nb": 0, "key": 0, "deps": 0, "hash": 0, "startup": 0, "eval": 0}
+
+def ud_nb_local_tasks(tp):
+    calls["nb"] += 1
+    return 2 * tp.globals.N    # N tasks in each of the two classes
+
+def ud_make_key(g, l):
+    calls["key"] += 1
+    return l.i * 1000 + 7
+
+def ud_find_deps(tp, g, l):
+    calls["deps"] += 1
+    return ("CHAIN", l.i)
+
+def ud_key_hash(key):
+    calls["hash"] += 1
+    return hash(key) ^ 0x5bd1e995
+
+def never_here(es, task):
+    calls["eval"] += 1
+    from parsec_tpu.runtime import HOOK_RETURN_NEXT
+    return HOOK_RETURN_NEXT
+
+def ud_startup(tp, context, g):
+    calls["startup"] += 1
+    return [{"i": i} for i in range(g.N)]
+%}
+
+%option nb_local_tasks_fn = ud_nb_local_tasks
+
+V [type = data]
+N [type = int]
+out [type = object]
+ud_hs [type = object]
+
+CHAIN(i) [make_key_fn = ud_make_key  find_deps_fn = ud_find_deps  hash_struct = ud_hs]
+  i = 0 .. N - 1
+  SIMCOST i + 1
+  : V(0)
+  RW A <- (i == 0) ? V(0) : A CHAIN(i-1)
+       -> (i < N - 1) ? A CHAIN(i+1) : V(0)
+BODY [evaluate = never_here]
+  out.append(("never", i))
+END
+BODY
+  A[...] += 1
+  out.append(("chain", i))
+END
+
+FREE(i) [startup_fn = ud_startup]
+  i = 0 .. N - 1
+  : V(0)
+  READ X <- V(0)
+BODY
+  out.append(("free", i))
+END
+"""
+
+
+def test_ud_overrides_full_family():
+    from parsec_tpu.runtime.task import KeyHashStruct
+    out = []
+    N = 5
+    V = VectorTwoDimCyclic("V", lm=4, mb=4,
+                           init_fn=lambda m, size: np.zeros(size))
+    jdf = ptg.parse_jdf(_UD_JDF, "udf")
+    ns = {}
+    # hash_struct must resolve via build() bindings: pass a KeyHashStruct
+    hs_calls = []
+    hs = KeyHashStruct(key_hash=lambda k: hs_calls.append(k) or hash(k),
+                       key_print=lambda k: f"<udkey {k}>")
+    tp = jdf.build(V=V, N=N, out=out, ud_hs=hs)
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+
+    calls = jdf_prologue_calls(jdf)
+    # nb_local_tasks_fn replaced the space scan
+    assert calls["nb"] == 1
+    # the chain ran in order, through the UD keys/deps/hash
+    chain = [i for tag, i in out if tag == "chain"]
+    assert chain == list(range(N))
+    assert calls["key"] > 0 and calls["deps"] > 0
+    assert hs_calls, "user key_hash never consulted"
+    # the evaluate hook skipped the first body every time
+    assert calls["eval"] == N
+    assert not [1 for tag, _ in out if tag == "never"]
+    # UD startup enumerated FREE itself
+    assert calls["startup"] == 1
+    assert sorted(i for tag, i in out if tag == "free") == list(range(N))
+    # SIMCOST critical path: chain costs 1+2+...+N
+    assert tp.largest_simulation_date == pytest.approx(N * (N + 1) / 2)
+    # final chain value wrote back
+    assert np.asarray(V.data_of(0).newest_copy().value)[0] == N
+
+
+def jdf_prologue_calls(jdf):
+    """Re-exec the prologue to reach its namespace?  No — bodies closed
+    over the ORIGINAL namespace; expose it through a probe build."""
+    # The prologue dict is shared by reference inside the built pool's
+    # bodies; simplest access: parse_jdf keeps sources, but build() made a
+    # fresh ns.  Instead, stash: JDF.build stores the last namespace.
+    return jdf._last_ns["calls"]
+
+
+def test_per_pool_termdet_option():
+    src = """
+%option termdet = user_trigger
+V [type = data]
+T(i)
+  i = 0 .. 0
+  : V(0)
+  READ X <- V(0)
+BODY
+  pass
+END
+"""
+    V = VectorTwoDimCyclic("V", lm=1, mb=1,
+                           init_fn=lambda m, size: np.zeros(size))
+    jdf = ptg.parse_jdf(src, "td")
+    tp = jdf.build(V=V)
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp)
+        assert isinstance(tp.tdm, UserTriggerTermDet)
+        assert not tp.test()       # tasks done but only trigger() terminates
+        tp.tdm.trigger()
+        ctx.wait(timeout=60)
+    assert tp.test()
+
+
+def test_empty_ranged_fanin_runs_immediately():
+    """An active ranged CTL input whose range is EMPTY for these locals
+    expects zero arrivals — the task must start, not hang (review r4)."""
+    ran = []
+    src = """
+V [type = data]
+out [type = object]
+P(i)
+  i = 0 .. K - 1
+  : V(0)
+  CTL c -> c J(0)
+BODY
+  pass
+END
+J(z)
+  z = 0 .. 0
+  : V(0)
+  CTL c <- c P(0 .. K - 1)
+BODY
+  out.append("ran")
+END
+"""
+    V = VectorTwoDimCyclic("V", lm=1, mb=1,
+                           init_fn=lambda m, size: np.zeros(size))
+    jdf = ptg.parse_jdf("K [type = int]\n" + src, "empty")
+    tp = jdf.build(V=V, out=ran, K=0)     # K=0: P's space AND the range empty
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=30)
+    assert ran == ["ran"]
+
+
+def test_dsl_rejects_ranged_data_flow():
+    p = ptg.PTGBuilder("bad", N=2)
+    t = p.task("T", i=ptg.span(0, 1))
+    f = t.flow("X", ptg.READ)
+    with pytest.raises(ValueError, match="CTL-only"):
+        f.input(pred=("T", "X", lambda g, l: ({"i": 0}, {"i": 1})),
+                ranged=True)
+
+
+def test_ud_jdf_errors():
+    with pytest.raises(ptg.JDFError, match="unknown %option"):
+        ptg.parse_jdf("%option bogus_fn = x\nV [type = data]\n",
+                      "e").build(V=1)
+    with pytest.raises(ptg.JDFError, match="does not name"):
+        src = """
+V [type = data]
+T(i) [make_key_fn = missing_fn]
+  i = 0 .. 0
+  : V(0)
+  READ X <- V(0)
+BODY
+  pass
+END
+"""
+        ptg.parse_jdf(src, "e2").build(V=1)
+    with pytest.raises(ptg.JDFError, match="CTL-only"):
+        src = """
+V [type = data]
+A(i)
+  i = 0 .. 3
+  : V(0)
+  RW X <- V(0)
+BODY
+  pass
+END
+B(i)
+  i = 0 .. 0
+  : V(0)
+  READ X <- X A(0 .. 3)
+BODY
+  pass
+END
+"""
+        ptg.parse_jdf(src, "e3").build(V=1)
+    with pytest.raises(ptg.JDFError, match="SIMCOST needs"):
+        ptg.parse_jdf("V [type = data]\nT(i)\n  i = 0 .. 0\n  SIMCOST\n",
+                      "e4")
