@@ -132,6 +132,10 @@ class PropertiesDictionary:
         with self._lock:
             self._props.pop((namespace, name), None)
 
+    def has(self, namespace: str, name: str) -> bool:
+        with self._lock:
+            return (namespace, name) in self._props
+
     def snapshot(self) -> dict[str, dict[str, Any]]:
         with self._lock:
             items = list(self._props.items())
@@ -167,6 +171,14 @@ class PropertiesDictionary:
             th.join(timeout=5)
 
         return stopper
+
+
+def read_live_snapshot(path: str) -> dict:
+    """Read the latest streamed snapshot (the dashboard-consumer half of
+    the aggregator_visu pair).  Atomic-rename writes make this safe to
+    call while the producer streams."""
+    with open(path) as f:
+        return json.load(f)
 
 
 properties = PropertiesDictionary()

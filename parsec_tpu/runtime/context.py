@@ -39,6 +39,12 @@ _params.register("runtime_bind_threads", False,
 _params.register("sched", "lfq", "scheduler component to use")
 _params.register("termdet", "", "termination detector override")
 _params.register("runtime_nb_vp", 1, "number of virtual processes")
+_params.register("props_stream", "",
+                 "path to stream live properties-dictionary JSON snapshots "
+                 "to while the context runs (the aggregator_visu feed; "
+                 "empty = off)")
+_params.register("props_stream_interval", 0.1,
+                 "seconds between live property snapshots")
 
 
 class Context:
@@ -97,6 +103,42 @@ class Context:
         self.scheduler.install(self)
         for es in streams:
             self.scheduler.flow_init(es)
+
+        # live properties (dictionary.c role): the context publishes its
+        # hot gauges; ``props_stream`` additionally tails them to a JSON
+        # file an external observer reads mid-run (aggregator_visu role).
+        # The namespace de-collides when several contexts of one rank are
+        # live at once, and the getters hold the context only weakly — a
+        # context that never reaches fini() must not be kept alive (or
+        # have its registrations clobbered/stolen) by the global registry.
+        import weakref
+        from ..prof.counters import properties, sde
+        base = f"rank{my_rank}"
+        ns = base
+        i = 1
+        while properties.has(ns, "sched_pending"):
+            ns = f"{base}#{i}"
+            i += 1
+        self._props_ns = ns
+        self._props_stop: Callable[[], None] | None = None
+        ref = weakref.ref(self)
+
+        def gauge(fn: Callable[["Context"], Any]) -> Callable[[], Any]:
+            def get():
+                c = ref()
+                return fn(c) if c is not None else 0
+            return get
+
+        properties.register(ns, "sched_pending",
+                            gauge(lambda c: c.scheduler.pending_tasks(c)))
+        properties.register(ns, "active_taskpools",
+                            gauge(lambda c: len(c._active_taskpools)))
+        properties.register(ns, "nb_tasks",
+                            gauge(lambda c: sum(
+                                tp.tdm.nb_tasks
+                                for tp in c._active_taskpools
+                                if tp.tdm is not None)))
+        properties.register(ns, "sde", sde.snapshot)
 
         # worker threads
         self._threads: list[threading.Thread] = []
@@ -167,6 +209,11 @@ class Context:
         """``parsec_context_start``: open the barrier, wake the comm thread."""
         with self._lock:
             self.started = True
+        path = _params.get("props_stream")
+        if path and self._props_stop is None:
+            from ..prof.counters import properties
+            self._props_stop = properties.stream_to(
+                path, _params.get("props_stream_interval"))
         if self.comm_engine is not None:
             self.comm_engine.enable()
         self._start_barrier.set()
@@ -196,6 +243,7 @@ class Context:
         self.scheduler.remove(self)
         if self.comm_engine is not None:
             self.comm_engine.fini()
+        self._props_teardown()
 
     def __enter__(self) -> "Context":
         return self
@@ -215,6 +263,15 @@ class Context:
         for t in self._threads:
             t.join(timeout=5)
         self.scheduler.remove(self)
+        self._props_teardown()
+
+    def _props_teardown(self) -> None:
+        if self._props_stop is not None:
+            self._props_stop()
+            self._props_stop = None
+        from ..prof.counters import properties
+        for name in ("sched_pending", "active_taskpools", "nb_tasks", "sde"):
+            properties.unregister(self._props_ns, name)
 
     # ------------------------------------------------------- progress loops
     def _bind_worker(self, es: ExecutionStream) -> None:

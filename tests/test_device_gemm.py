@@ -66,6 +66,11 @@ class TestTiledGemmDevice:
         np.testing.assert_allclose(C.to_dense(), c + a @ b, rtol=1e-3)
         assert accel_device.executed_tasks == 4 * 4 * 4
         assert accel_device.bytes_in > 0
+        # attribution instrumentation: every phase wall + the call counter
+        # accumulate during a real run (the bench breakdown's inputs)
+        assert accel_device.xla_calls > 0
+        assert accel_device.t_manager > 0
+        assert accel_device.t_stage_in >= 0 and accel_device.t_dispatch > 0
 
     def test_best_device_prefers_accel_for_big_tiles(self, accel_device):
         rng = np.random.default_rng(2)

@@ -1,0 +1,122 @@
+"""Live properties export: an external observer reads runtime gauges
+mid-run (the ``dictionary.c`` + ``tools/aggregator_visu`` pair, VERDICT r3
+missing #4): the context registers its scheduler depth / task gauges in
+the properties dictionary and, with ``props_stream`` set, tails JSON
+snapshots to a file while taskpools execute.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parsec_tpu import ptg
+import parsec_tpu.runtime.dagrun  # noqa: F401  (registers runtime_dag_compile)
+from parsec_tpu.core.params import params
+from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
+from parsec_tpu.prof.counters import properties, read_live_snapshot, sde
+from parsec_tpu.runtime import Context
+
+
+@pytest.fixture
+def param():
+    saved = {}
+
+    def set_(name, value):
+        saved[name] = params.get(name)
+        params.set(name, value)
+
+    yield set_
+    for name, value in saved.items():
+        params.set(name, value)
+
+
+def _slow_chain(V, nt, delay):
+    p = ptg.PTGBuilder("slow", V=V, NT=nt, D=delay)
+    t = p.task("T", i=ptg.span(0, lambda g, l: g.NT - 1))
+    t.affinity("V", lambda g, l: (0,))
+    f = t.flow("A", ptg.RW)
+    f.input(data=("V", lambda g, l: (0,)), guard=lambda g, l: l.i == 0)
+    f.input(pred=("T", "A", lambda g, l: {"i": l.i - 1}),
+            guard=lambda g, l: l.i > 0)
+    f.output(succ=("T", "A", lambda g, l: {"i": l.i + 1}),
+             guard=lambda g, l: l.i < g.NT - 1)
+    f.output(data=("V", lambda g, l: (0,)),
+             guard=lambda g, l: l.i == g.NT - 1)
+
+    def body(es, task, g, l):
+        time.sleep(g.D)
+        task.flow_data("A").value[...] += 1.0
+
+    t.body(body)
+    return p.build()
+
+
+def test_snapshot_readable_during_run(tmp_path, param):
+    """The acceptance gate: a reader thread observes a streamed snapshot
+    WHILE the taskpool is still executing, and the snapshot carries the
+    context gauges."""
+    path = str(tmp_path / "props.json")
+    param("props_stream", path)
+    param("props_stream_interval", 0.02)
+    param("runtime_dag_compile", False)   # keep the dynamic path visible
+
+    V = VectorTwoDimCyclic("V", lm=4, mb=4,
+                           init_fn=lambda m, size: np.zeros(size))
+    tp = _slow_chain(V, nt=12, delay=0.05)
+    seen: list[dict] = []
+    ctx = Context(nb_cores=1)
+
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            try:
+                snap = read_live_snapshot(path)
+            except (FileNotFoundError, ValueError):
+                time.sleep(0.01)
+                continue
+            if not tp.test():          # captured strictly mid-run
+                seen.append(snap)
+            time.sleep(0.01)
+
+    th = threading.Thread(target=reader)
+    th.start()
+    try:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+    finally:
+        stop.set()
+        th.join(timeout=10)
+        ctx.fini()
+
+    assert seen, "no snapshot observed while the taskpool was running"
+    snap = seen[-1]
+    assert "ts" in snap
+    r0 = snap["props"]["rank0"]
+    assert r0["active_taskpools"] >= 1
+    assert r0["nb_tasks"] >= 1          # tasks still outstanding mid-run
+    assert "sched_pending" in r0 and "sde" in r0
+
+
+def test_properties_registry_lifecycle(param):
+    """Context registration appears in the dictionary and is removed at
+    fini (no leakage across contexts)."""
+    ctx = Context(nb_cores=0)
+    snap = properties.snapshot()
+    assert "rank0" in snap and "sched_pending" in snap["rank0"]
+    ctx.fini()
+    snap = properties.snapshot()
+    assert "rank0" not in snap
+
+
+def test_custom_property_and_sde_in_snapshot(param):
+    properties.register("app", "phase", lambda: "factorize")
+    try:
+        sde.inc("app::custom", 3)
+        snap = properties.snapshot()
+        assert snap["app"]["phase"] == "factorize"
+        assert sde.get("app::custom") >= 3
+    finally:
+        properties.unregister("app", "phase")
