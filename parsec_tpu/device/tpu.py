@@ -202,17 +202,18 @@ class TPUDevice(Device):
                     except Exception:
                         pass    # transfer falls back to the sync read below
                 victims.append(c)
+        i = 0
         try:
-            while victims:
-                self._writeback(victims[0])
-                victims.pop(0)
+            while i < len(victims):
+                self._writeback(victims[i])
+                i += 1
                 self.deferred_evictions += 1
         except BaseException:
             # a failed writeback must leave the unwritten victims
             # reachable: failure recovery salvages from _evict_q, and a
             # dirty copy outside it would be silently dropped
             with self._lru_lock:
-                for c in victims:
+                for c in victims[i:]:
                     self._evict_bytes += _copy_nbytes(c)
                     self._evict_q.append(c)
             raise

@@ -279,6 +279,12 @@ class DTDTaskpool(Taskpool):
         self._classes: dict[Any, _DTDTaskClass] = {}
         self._tiles: dict[tuple, DTDTile] = {}
         self._tlock = threading.Lock()
+        # serializes insert_task: bodies may insert tasks from worker
+        # threads (recursive discovery — haar_tree/merge_sort shape), and
+        # the seq numbering + accessor-chain splices assume one inserter
+        # at a time.  RLock: a body executed from inside the window
+        # backpressure drive may itself insert.
+        self._insert_lock = threading.RLock()
         self._inflight = 0
         self._icond = threading.Condition()
         self._armed = False
@@ -402,6 +408,18 @@ class DTDTaskpool(Taskpool):
         """
         if self.context is None:
             raise RuntimeError("taskpool not enqueued in a context")
+        with self._insert_lock:
+            task = self._insert_task_locked(body, args, name, priority,
+                                            tpu_kernel, _rank)
+        # backpressure OUTSIDE the insert lock: a blocked inserter must not
+        # stop worker bodies (which may themselves insert) from completing
+        # tasks — that would hold _inflight above the threshold forever
+        if not task.is_shell:
+            self._window_backpressure()
+        return task
+
+    def _insert_task_locked(self, body: Callable, args: tuple, name,
+                            priority, tpu_kernel, _rank) -> DTDTask:
         multirank = self.context.nb_ranks > 1
         specs: list[_ArgSpec] = []
         for a in args:
@@ -460,7 +478,6 @@ class DTDTaskpool(Taskpool):
         if ready:
             task.status = "ready"
             schedule_tasks(self.context._submit_es, [task], 0)
-        self._window_backpressure()
         return task
 
     def _attach_tile_copy(self, task: DTDTask, spec: _ArgSpec,
@@ -710,7 +727,11 @@ class DTDTaskpool(Taskpool):
     # --------------------------------------------------------------- window
     def _window_backpressure(self) -> None:
         """``parsec_execute_and_come_back``: above ``window_size`` in-flight
-        tasks the inserter pitches in (no workers) or blocks (workers)."""
+        tasks the inserter pitches in (no workers), blocks (external
+        thread with workers), or — when the inserter IS a worker running a
+        task body (recursive discovery) — executes-and-comes-back on its
+        own stream: parking it would strand its unfinished task, and with
+        every worker inserting at once nothing could ever drain."""
         if self._inflight <= self.window_size:
             return
         ctx = self.context
@@ -719,6 +740,19 @@ class DTDTaskpool(Taskpool):
             # execute-and-come-back contract cannot hold otherwise)
             ctx.start()
         if ctx._threads:
+            ident = threading.get_ident()
+            es = next((s for s in ctx.streams if s.owner_ident == ident),
+                      None)
+            if es is not None:
+                # worker-thread inserter: drive tasks instead of parking
+                from ..runtime.scheduling import (select_task,
+                                                  task_progress)
+                while self._inflight > self.threshold_size:
+                    t, distance = select_task(es)
+                    if t is None:
+                        return   # nothing runnable here; don't spin
+                    task_progress(es, t, distance)
+                return
             with self._icond:
                 self._icond.wait_for(
                     lambda: self._inflight <= self.threshold_size)

@@ -1,5 +1,5 @@
 """Library taskpools / flagship applications built on the runtime."""
 
-from . import tiled_gemm
+from . import irregular, tiled_gemm
 
-__all__ = ["tiled_gemm"]
+__all__ = ["irregular", "tiled_gemm"]

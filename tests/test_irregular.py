@@ -1,0 +1,158 @@
+"""Irregular / dynamic-graph app tier (reference tests/apps/haar_tree,
+merge_sort, all2all): runtime-discovered tree recursion through DTD and
+the all-to-all comm cross-product through PTG.
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm import run_multirank
+from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
+from parsec_tpu.dtd import DTDTaskpool
+from parsec_tpu.models.irregular import (all2all_ptg, haar_project_dtd,
+                                         haar_project_reference,
+                                         merge_sort_dtd)
+from parsec_tpu.runtime import Context
+
+
+# ---------------------------------------------------------------------------
+# adaptive Haar tree: bodies insert their own children
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("nb_cores", [0, 4])
+def test_haar_tree_discovery(nb_cores):
+    """The DTD-discovered refinement tree matches the sequential oracle —
+    including with 4 workers racing their insertions."""
+    alpha, thresh = 1.0, 1e-4
+    want = haar_project_reference(alpha, thresh, min_depth=4, max_depth=20)
+    assert len(want) > 50, "oracle tree unexpectedly small"
+    with Context(nb_cores=nb_cores) as ctx:
+        tp = DTDTaskpool("haar")
+        ctx.add_taskpool(tp)
+        tree = haar_project_dtd(tp, alpha, thresh, min_depth=4, max_depth=20)
+        tp.wait(timeout=120)
+    assert set(tree) == set(want)
+    for k in want:
+        assert tree[k] == pytest.approx(want[k])
+
+
+def test_haar_tree_worker_inserters_survive_tiny_window():
+    """Backpressure with every inserter a worker executing a body: workers
+    must execute-and-come-back, not park (review r4: parking all workers
+    above the window deadlocks the run)."""
+    from parsec_tpu.core.params import params
+    saved = (params.get("dtd_window_size"), params.get("dtd_threshold_size"))
+    params.set("dtd_window_size", 8)
+    params.set("dtd_threshold_size", 4)
+    try:
+        want = haar_project_reference(1.0, 1e-4, min_depth=4, max_depth=20)
+        with Context(nb_cores=4) as ctx:
+            tp = DTDTaskpool("haar_win")
+            ctx.add_taskpool(tp)
+            tree = haar_project_dtd(tp, 1.0, 1e-4, min_depth=4,
+                                    max_depth=20)
+            tp.wait(timeout=120)
+        assert set(tree) == set(want)
+    finally:
+        params.set("dtd_window_size", saved[0])
+        params.set("dtd_threshold_size", saved[1])
+
+
+def test_haar_tree_depth_is_data_dependent():
+    """Different thresholds give different tree shapes — the structure is
+    discovered, not enumerated."""
+    with Context(nb_cores=0) as ctx:
+        tp = DTDTaskpool("haar1")
+        ctx.add_taskpool(tp)
+        coarse = haar_project_dtd(tp, 1.0, 1e-2, min_depth=2, max_depth=20)
+        tp.wait(timeout=120)
+    with Context(nb_cores=0) as ctx:
+        tp = DTDTaskpool("haar2")
+        ctx.add_taskpool(tp)
+        fine = haar_project_dtd(tp, 1.0, 1e-5, min_depth=2, max_depth=20)
+        tp.wait(timeout=120)
+    assert len(fine) > len(coarse)
+    assert set(coarse) < set(fine)
+
+
+# ---------------------------------------------------------------------------
+# merge sort
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,run,nb_cores", [
+    (1000, 64, 0),
+    (4096, 128, 2),
+    (777, 50, 2),        # ragged runs + odd level widths
+])
+def test_merge_sort(n, run, nb_cores):
+    rng = np.random.default_rng(n)
+    data = rng.standard_normal(n).astype(np.float32)
+    with Context(nb_cores=nb_cores) as ctx:
+        tp = DTDTaskpool("msort")
+        ctx.add_taskpool(tp)
+        out = merge_sort_dtd(tp, data, run=run)
+        tp.wait(timeout=120)
+    np.testing.assert_array_equal(out, np.sort(data))
+
+
+def test_merge_sort_int_keys():
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 10 ** 6, size=2000).astype(np.int64)
+    with Context(nb_cores=0) as ctx:
+        tp = DTDTaskpool("msort_i")
+        ctx.add_taskpool(tp)
+        out = merge_sort_dtd(tp, data, run=37)
+        tp.wait(timeout=120)
+    np.testing.assert_array_equal(out, np.sort(data))
+
+
+# ---------------------------------------------------------------------------
+# all-to-all
+# ---------------------------------------------------------------------------
+
+def _a2a_vectors(nranks, rank, nt, mb, seed=0):
+    rng = np.random.default_rng(seed)
+    a0 = rng.standard_normal((nt, mb)).astype(np.float32)
+    b0 = rng.standard_normal((nt, mb)).astype(np.float32)
+    A = VectorTwoDimCyclic("A", lm=nt * mb, mb=mb, P=nranks, myrank=rank,
+                           init_fn=lambda m, size: a0[m, :size].copy())
+    B = VectorTwoDimCyclic("B", lm=nt * mb, mb=mb, P=nranks, myrank=rank,
+                           init_fn=lambda m, size: b0[m, :size].copy())
+    return a0, b0, A, B
+
+
+def test_all2all_single_rank():
+    nt, mb, rounds = 4, 8, 3
+    a0, b0, A, B = _a2a_vectors(1, 0, nt, mb)
+    tp = all2all_ptg(A, B, rounds)
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+    want = b0 + rounds * a0.sum(axis=0)
+    for s in range(nt):
+        np.testing.assert_allclose(
+            np.asarray(B.data_of(s).newest_copy().value), want[s],
+            rtol=1e-5)
+
+
+def _a2a_rank_body(ctx, rank, nranks):
+    nt, mb, rounds = 8, 4, 2
+    a0, b0, A, B = _a2a_vectors(nranks, rank, nt, mb, seed=2)
+    tp = all2all_ptg(A, B, rounds)
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=180)
+    ctx.comm_barrier()
+    want = b0 + rounds * a0.sum(axis=0)
+    for s in range(nt):
+        if B.rank_of(s) != rank:
+            continue
+        np.testing.assert_allclose(
+            np.asarray(B.data_of(s).newest_copy().value), want[s],
+            rtol=1e-5)
+    return True
+
+
+def test_all2all_multirank():
+    """Every tile of every rank reaches every destination each round —
+    the comm-engine cross-product stress (a2a.jdf role)."""
+    assert all(run_multirank(4, _a2a_rank_body))
