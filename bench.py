@@ -97,6 +97,46 @@ def bench_gemm_gflops(n: int = 16384, nb: int = 512, reps: int = 48) -> dict:
     }
 
 
+def bench_raw_dot_gflops(n: int = 16384, reps: int = 48) -> dict:
+    """Honesty cross-check for the headline (VERDICT r3 weak #6): the same
+    flops as ONE bare ``jnp.dot`` chain, no framework anywhere — pct_peak
+    rests on the hand-entered flop table, so record what the raw compiler
+    achieves on this chip under the identical loop-carry discipline."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((n, n), dtype=np.float32),
+                    dtype=jnp.bfloat16)
+    b = jnp.asarray(rng.standard_normal((n, n), dtype=np.float32),
+                    dtype=jnp.bfloat16)
+
+    @functools.partial(jax.jit, static_argnames=("reps",))
+    def chain(a, b, reps):
+        def body(c, _):
+            # feed (zero of) c back into a so the dot is loop-carried
+            eps = (c.reshape(-1)[0] * 0).astype(a.dtype)
+            return jnp.dot(a.at[0, 0].add(eps), b,
+                           preferred_element_type=jnp.float32), None
+        c0 = jnp.zeros((n, n), jnp.float32)
+        c, _ = jax.lax.scan(body, c0, None, length=reps)
+        return c
+
+    _ = float(chain(a, b, reps).reshape(-1)[0])   # compile + warm
+    times = []
+    for _i in range(3):
+        t0 = time.perf_counter()
+        out = chain(a, b, reps)
+        _sink = float(out.reshape(-1)[0])
+        times.append(time.perf_counter() - t0)
+    t = statistics.median(times)
+    return {"gflops": 2.0 * n * n * n * reps / t / 1e9, "n": n,
+            "reps": reps, "seconds": t}
+
+
 def bench_dynamic_gemm_gflops(n: int = 8192, nb: int = 1024) -> dict:
     """The dynamic-runtime path on the real chip: PTG GEMM(m,n,k) executed
     task by task through the TPU device module (stage-in, LRU cache, vmapped
@@ -416,6 +456,7 @@ def main() -> None:
     dyn = bench_dynamic_gemm_gflops()
     dtd = bench_dtd_gemm_tpu()
     chol = bench_dynamic_cholesky_gflops()
+    raw = bench_raw_dot_gflops(n=n)
     gemm = bench_gemm_gflops(n=n)
     target = 0.70 * gemm["peak_gflops"]
     print(json.dumps({
@@ -430,6 +471,9 @@ def main() -> None:
             "nb": gemm["nb"],
             "gemm_seconds": round(gemm["seconds"], 4),
             "lowering": gemm["lowering"],
+            # raw-compiler cross-check: bare jnp.dot at the same config;
+            # framework/raw ~ 1.0 means the taskpool lowering costs nothing
+            "raw_dot_gflops": round(raw.get("gflops", 0.0), 1),
             "task_dispatch_us": round(dispatch_us, 2),
             "dynamic_gemm_gflops": round(dyn.get("gflops", 0.0), 1),
             "dynamic_gemm_batched": dyn.get("batched_dispatches", 0),

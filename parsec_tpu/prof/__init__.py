@@ -18,6 +18,7 @@ from . import task_profiler as _task_profiler   # register components
 from . import grapher as _grapher               # register components
 from . import debug_marks as _debug_marks       # register components
 from . import iterators_checker as _iterchk     # register components
+from . import perf_modules as _perf_modules     # register components
 
 __all__ = ["PinsEvent", "pins", "Profiling", "trace_state", "properties",
            "sde"]

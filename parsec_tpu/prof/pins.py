@@ -39,6 +39,9 @@ class PinsEvent(IntEnum):
     DAG_FETCH_END = 19
     DAG_COMPLETE_BEGIN = 20
     DAG_COMPLETE_END = 21
+    # a select that pulled work from beyond the stream's own queue
+    # (payload: (task, distance)) — feeds the print_steals module
+    SELECT_STEAL = 22
 
 
 Callback = Callable[[Any, Any], None]   # (execution_stream_or_none, payload)

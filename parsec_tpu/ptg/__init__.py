@@ -2,12 +2,12 @@
 
 from .dsl import (CTL, READ, RW, WRITE, FlowBuilder, PTGBuilder, PTGTaskpool,
                   TaskClassBuilder, span)
-from .jdf import JDF, JDFError, load_jdf, parse_jdf
+from .jdf import JDF, JDFError, load_jdf, parse_jdf, unparse_jdf
 from .lowering import (LoweredTaskpool, LoweringError, find_traceable,
                        lower_taskpool, register_traceable)
 
 __all__ = ["CTL", "READ", "RW", "WRITE", "FlowBuilder", "PTGBuilder",
            "PTGTaskpool", "TaskClassBuilder", "span", "JDF", "JDFError",
-           "load_jdf", "parse_jdf",
+           "load_jdf", "parse_jdf", "unparse_jdf",
            "LoweredTaskpool", "LoweringError", "find_traceable",
            "lower_taskpool", "register_traceable"]

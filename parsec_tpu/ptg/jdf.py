@@ -575,6 +575,98 @@ def _parse_props(s: str | None) -> dict:
     return out
 
 
+def unparse_jdf(jdf: JDF) -> str:
+    """Render a parsed :class:`JDF` back to JDF text (``jdf_unparse``,
+    ``jdf.h:137`` / ``jdf_unparse.c``): the round-trip tool — the output
+    re-parses to an equivalent template (prologues, %options, globals,
+    task properties, execution space, derived locals, affinity, SIMCOST,
+    flows with guarded/ranged arrows and dep properties, priorities,
+    bodies)."""
+    out: list[str] = []
+    for src in jdf.prologue_src:
+        out.append("%{" + src + "%}")
+    for oname, oval in jdf.options.items():
+        out.append(f"%option {oname} = {oval}")
+    if jdf.options:
+        out.append("")
+    for gname, props in jdf.globals_decl.items():
+        line = gname
+        if "default" in props:
+            line += f" = {props['default']}"
+        rest = [f"{k} = {v}" if v is not True else k
+                for k, v in props.items() if k != "default"]
+        if rest:
+            line += "  [" + "  ".join(rest) + "]"
+        out.append(line)
+    out.append("")
+
+    def tgt(t: tuple) -> str:
+        kind, name, flow, args = t
+        if kind == "new":
+            return "NEW"
+        if kind == "null":
+            return "NULL"
+        if kind == "task":
+            return f"{flow} {name}({args})"
+        return f"{name}({args})"
+
+    for td in jdf.tasks.values():
+        head = f"{td.name}({', '.join(td.params)})"
+        if td.props:
+            head += "  [" + "  ".join(f"{k} = {v}"
+                                      for k, v in td.props.items()) + "]"
+        out.append(head)
+        for p in td.params:
+            lo, hi, step = td.ranges[p]
+            if step is not None:
+                out.append(f"  {p} = {lo} .. {hi} .. {step}")
+            elif lo == hi:
+                out.append(f"  {p} = {lo}")
+            else:
+                out.append(f"  {p} = {lo} .. {hi}")
+        for dn, src in td.derived.items():
+            out.append(f"  {dn} = {src}")
+        if td.affinity_src is not None:
+            out.append(f"  : {td.affinity_src[0]}({td.affinity_src[1]})")
+        if td.simcost_src is not None:
+            out.append(f"  SIMCOST {td.simcost_src}")
+        for fd in td.flows:
+            acc = {RW: "RW", READ: "READ", WRITE: "WRITE",
+                   CTL: "CTL"}[fd.access]
+            prefix = f"  {acc} {fd.name} "
+            pad = " " * len(prefix)
+            first = True
+            for ar in fd.arrows:
+                arrow = "<-" if ar.direction == "in" else "->"
+                seg = tgt(ar.then_tgt)
+                if ar.guard_src is not None:
+                    # guard_src is stored parenthesized (the grammar
+                    # requires it) — emit verbatim
+                    seg = f"{ar.guard_src} ? {seg}"
+                    if ar.else_tgt is not None:
+                        seg += f" : {tgt(ar.else_tgt)}"
+                if ar.props:
+                    seg += "  [" + "  ".join(
+                        f"{k} = {v}" if v is not True else k
+                        for k, v in ar.props.items()) + "]"
+                out.append((prefix if first else pad) + f"{arrow} {seg}")
+                first = False
+            if first:
+                out.append(prefix.rstrip())
+        if td.priority_src is not None:
+            out.append(f"  ; {td.priority_src}")
+        for props, code in td.bodies:
+            line = "BODY"
+            if props:
+                line += " [" + "  ".join(f"{k} = {v}" if v is not True else k
+                                         for k, v in props.items()) + "]"
+            out.append(line)
+            out.append(code)
+            out.append("END")
+        out.append("")
+    return "\n".join(out)
+
+
 def load_jdf(path: Any, name: str | None = None) -> JDF:
     """Parse a ``.jdf`` file from disk (the ``parsec_ptgpp <file>`` entry)."""
     import pathlib

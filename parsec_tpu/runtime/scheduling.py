@@ -98,6 +98,11 @@ def select_task(es: ExecutionStream) -> tuple[Task | None, int]:
     pins.fire(PinsEvent.SELECT_BEGIN, es)
     t, distance = es.context.scheduler.select(es)
     pins.fire(PinsEvent.SELECT_END, es, t)
+    if t is not None and 0 < distance < 99:
+        # work pulled from ANOTHER stream's queue: a steal.  Distance 99
+        # is the schedulers' shared-system-queue sentinel — popping an
+        # externally-submitted task is starvation relief, not a steal
+        pins.fire(PinsEvent.SELECT_STEAL, es, (t, distance))
     return t, distance
 
 

@@ -27,7 +27,12 @@ class SchedulerModule:
         raise NotImplementedError
 
     def select(self, es: Any) -> tuple[Any | None, int]:
-        """Return (task, distance) or (None, 0)."""
+        """Return (task, distance) or (None, 0).
+
+        Distance contract: 0 = the stream's own queue; 1..98 = pulled from
+        another stream's queue, topologically-near first (a *steal* — the
+        SELECT_STEAL PINS feed); 99 = the shared system queue (externally
+        submitted work; starvation relief, not a steal)."""
         raise NotImplementedError
 
     def remove(self, context: Any) -> None:

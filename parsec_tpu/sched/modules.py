@@ -25,6 +25,9 @@ from typing import Any, Sequence
 from ..core.params import params as _params
 from ..core.hbbuffer import HBBuffer
 from ..core.mca import Component, component
+# imported at module load (main thread): the topology affinity snapshot
+# must be taken before any worker binds itself to a single core
+from ..core import topology as _topology
 from .api import SchedulerModule
 
 _params.register("sched_lfq_buffer_size", 8,
@@ -81,7 +84,7 @@ class LFQModule(SchedulerModule):
         vpq = es.virtual_process.sched_private
         with vpq.lock:
             if vpq.system:
-                return vpq.system.popleft(), 2
+                return vpq.system.popleft(), 99
         return None, 0
 
     def remove(self, context: Any) -> None:
@@ -350,15 +353,20 @@ class PBQModule(SchedulerModule):
     def _steal_order(self, es: Any) -> list:
         order = self._order.get(id(es))
         if order is None:
+            from ..core.topology import core_of_stream, distance
             sibs = es.virtual_process.execution_streams
             n = len(sibs)
             me = sibs.index(es)
+            my_core = core_of_stream(es.th_id)
             idx = {id(s): i for i, s in enumerate(sibs)}
-            # ring distance: the hwloc-proximity stand-in; static per
-            # stream, so computed once and cached
-            order = sorted((s for s in sibs if s is not es),
-                           key=lambda s: min((idx[id(s)] - me) % n,
-                                             (me - idx[id(s)]) % n))
+            # topology-near first (same LLC before cross-cache — the
+            # hwloc distance matrix), ring distance as the tiebreak;
+            # static per stream, so computed once and cached
+            order = sorted(
+                (s for s in sibs if s is not es),
+                key=lambda s: (distance(my_core, core_of_stream(s.th_id)),
+                               min((idx[id(s)] - me) % n,
+                                   (me - idx[id(s)]) % n)))
             self._order[id(es)] = order
         return order
 
@@ -464,13 +472,18 @@ class LHQModule(PBQModule):
 
     def install(self, context: Any) -> None:
         super().install(context)
+        from ..core.topology import core_of_stream, llc_group_of
         self._group: dict[int, Any] = {}   # id(es) -> its group buffer
         for vp in context.virtual_processes:
-            # two groups per VP (the socket split stand-in)
-            ngroups = 2 if len(vp.execution_streams) > 1 else 1
+            # one group buffer per last-level cache represented among this
+            # VP's streams (the real hwloc rung; a VP whose streams all
+            # share one LLC gets one group — no artificial split)
             vpq = vp.sched_private
+            llcs = sorted({llc_group_of(core_of_stream(s.th_id))
+                           for s in vp.execution_streams})
+            vpq.llc_index = {llc: i for i, llc in enumerate(llcs)}
             vpq.groups = []
-            for _g in range(ngroups):
+            for _g in llcs:
                 def spill(items: list, distance: int, vpq=vpq) -> None:
                     with vpq.lock:
                         vpq.system.extend(items)
@@ -479,10 +492,10 @@ class LHQModule(PBQModule):
     def _group_of(self, es: Any):
         grp = self._group.get(id(es))
         if grp is None:
-            sibs = es.virtual_process.execution_streams
+            from ..core.topology import core_of_stream, llc_group_of
             vpq = es.virtual_process.sched_private
-            g = 0 if sibs.index(es) < (len(sibs) + 1) // 2 else 1
-            grp = vpq.groups[min(g, len(vpq.groups) - 1)]
+            g = vpq.llc_index[llc_group_of(core_of_stream(es.th_id))]
+            grp = vpq.groups[g]
             self._group[id(es)] = grp
         return grp
 

@@ -22,3 +22,21 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu", jax.default_backend()
 assert len(jax.devices()) == 8, jax.devices()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def param():
+    """Scoped MCA-parameter override: set through the registry, restored
+    at test exit (shared by every test module)."""
+    from parsec_tpu.core.params import params
+    saved = {}
+
+    def set_(name, value):
+        saved[name] = params.get(name)
+        params.set(name, value)
+
+    yield set_
+    for name, value in saved.items():
+        params.set(name, value)

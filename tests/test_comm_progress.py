@@ -14,19 +14,6 @@ from parsec_tpu.models.tiled_gemm import tiled_gemm_ptg
 from parsec_tpu.runtime import Context  # noqa: F401 (e2e bodies)
 
 
-@pytest.fixture
-def param(request):
-    saved = {}
-
-    def set_(name, value):
-        saved[name] = params.get(name)
-        params.set(name, value)
-
-    yield set_
-    for name, value in saved.items():
-        params.set(name, value)
-
-
 class _SpyEngine:
     """Captures send_am calls; quacks enough of CommEngine for the stage."""
 

@@ -15,19 +15,6 @@ from parsec_tpu.data_dist.collection import DictCollection
 from parsec_tpu.runtime import Context
 
 
-@pytest.fixture
-def param():
-    saved = {}
-
-    def set_(name, value):
-        saved[name] = params.get(name)
-        params.set(name, value)
-
-    yield set_
-    for name, value in saved.items():
-        params.set(name, value)
-
-
 class TestRWLock:
     def test_readers_share_writers_exclude(self):
         lk = RWLock()

@@ -19,19 +19,6 @@ from parsec_tpu.prof.counters import properties, read_live_snapshot, sde
 from parsec_tpu.runtime import Context
 
 
-@pytest.fixture
-def param():
-    saved = {}
-
-    def set_(name, value):
-        saved[name] = params.get(name)
-        params.set(name, value)
-
-    yield set_
-    for name, value in saved.items():
-        params.set(name, value)
-
-
 def _slow_chain(V, nt, delay):
     p = ptg.PTGBuilder("slow", V=V, NT=nt, D=delay)
     t = p.task("T", i=ptg.span(0, lambda g, l: g.NT - 1))

@@ -16,19 +16,6 @@ from parsec_tpu.data_dist.matrix import TwoDimBlockCyclic
 from parsec_tpu.models.tiled_gemm import tiled_gemm_ptg
 
 
-@pytest.fixture
-def param():
-    saved = {}
-
-    def set_(name, value):
-        saved[name] = params.get(name)
-        params.set(name, value)
-
-    yield set_
-    for name, value in saved.items():
-        params.set(name, value)
-
-
 def _gemm_body(ctx, rank, nranks):
     n, nb = 96, 16
     rng = np.random.RandomState(41)
