@@ -1,0 +1,41 @@
+"""Ex08: recursive task bodies — a task re-enters the runtime with a
+nested taskpool over a finer tiling of its own tile.
+
+Reference ``parsec/recursive.h`` + ``PARSEC_DEV_RECURSIVE``
+(``device.h:64``): the body views its RW tile as a
+:class:`SubtileCollection`, spawns an inner GEMM taskpool over the
+sub-tiles, and detaches (``HOOK_RETURN_ASYNC``); the runtime completes
+it — and releases its successors — when the nested pool drains.
+"""
+
+import numpy as np
+
+from parsec_tpu.data_dist.matrix import TiledMatrix
+from parsec_tpu.models.tiled_gemm import tiled_gemm_recursive_ptg
+from parsec_tpu.runtime import Context
+
+N, NB, SUB = 64, 32, 8   # outer 2x2 tiles of 32, inner 4x4 sub-tiles of 8
+
+
+def main() -> float:
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((N, N)).astype(np.float32)
+    b = rng.standard_normal((N, N)).astype(np.float32)
+    A = TiledMatrix.from_dense("A", a.copy(), NB, NB)
+    B = TiledMatrix.from_dense("B", b.copy(), NB, NB)
+    C = TiledMatrix.from_dense("C", np.zeros((N, N), np.float32), NB, NB)
+
+    # each outer GEMM(m,n,k) recurses into an 8x8-tile inner GEMM; tiles
+    # smaller than min_tile would run the plain CPU chore instead
+    tp = tiled_gemm_recursive_ptg(A, B, C, sub_mb=SUB, sub_nb=SUB)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+
+    err = float(np.abs(C.to_dense() - a @ b).max())
+    print(f"recursive tiled GEMM: max|C - A@B| = {err:.2e}")
+    return err
+
+
+if __name__ == "__main__":
+    assert main() < 1e-3

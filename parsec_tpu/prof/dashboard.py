@@ -1,0 +1,98 @@
+"""Live runtime dashboard: the ``tools/aggregator_visu`` consumer.
+
+Tails the JSON snapshot stream a context writes when ``props_stream`` is
+set (:mod:`parsec_tpu.prof.counters`) and renders the gauges as a
+refreshing terminal table — scheduler depths, outstanding tasks, SDE
+counters, alperf throughput — one column per namespace (rank).
+
+Usage::
+
+    PARSEC_MCA_props_stream=/tmp/props.json python my_app.py &
+    python -m parsec_tpu.prof.dashboard /tmp/props.json
+
+The reference pairs a shared-memory dictionary (``dictionary.c``) with a
+Qt GUI; here the transport is an atomically-replaced file and the GUI a
+terminal loop — same division: the runtime never blocks on the observer,
+the observer never perturbs the runtime.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any
+
+from .counters import read_live_snapshot
+
+
+def render_snapshot(snap: dict) -> str:
+    """One snapshot -> a fixed-width table (pure; testable)."""
+    props: dict[str, dict[str, Any]] = snap.get("props", {})
+    ts = snap.get("ts", 0.0)
+    lines = [f"parsec-tpu live properties   "
+             f"@ {time.strftime('%H:%M:%S', time.localtime(ts))}"]
+    namespaces = sorted(props)
+    # collect the union of scalar gauge names; dict-valued gauges (sde)
+    # expand into their own rows
+    rows: dict[str, dict[str, Any]] = {}
+    for ns in namespaces:
+        for name, val in props[ns].items():
+            if isinstance(val, dict):
+                for k, v in val.items():
+                    rows.setdefault(f"{name}:{k}", {})[ns] = v
+            else:
+                rows.setdefault(name, {})[ns] = val
+    if not rows:
+        lines.append("  (no properties registered)")
+        return "\n".join(lines)
+    w0 = max(len(r) for r in rows) + 2
+    wc = max(12, *(len(ns) + 2 for ns in namespaces))
+    lines.append(" " * w0 + "".join(ns.rjust(wc) for ns in namespaces))
+    for rname in sorted(rows):
+        cells = []
+        for ns in namespaces:
+            v = rows[rname].get(ns, "")
+            if isinstance(v, float):
+                v = f"{v:.1f}"
+            cells.append(str(v).rjust(wc))
+        lines.append(rname.ljust(w0) + "".join(cells))
+    return "\n".join(lines)
+
+
+def watch(path: str, interval: float = 0.5, iterations: int | None = None,
+          out: Any = None) -> None:
+    """Refresh loop (``iterations=None`` runs until interrupted)."""
+    out = out or sys.stdout
+    n = 0
+    while iterations is None or n < iterations:
+        try:
+            snap = read_live_snapshot(path)
+            text = render_snapshot(snap)
+        except FileNotFoundError:
+            text = f"waiting for {path} ..."
+        except (ValueError, json.JSONDecodeError):
+            text = f"unreadable snapshot at {path} (mid-write?)"
+        out.write("\x1b[2J\x1b[H" if out is sys.stdout else "")
+        out.write(text + "\n")
+        out.flush()
+        n += 1
+        if iterations is None or n < iterations:
+            time.sleep(interval)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args or args[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    interval = float(args[1]) if len(args) > 1 else 0.5
+    try:
+        watch(args[0], interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

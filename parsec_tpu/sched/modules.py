@@ -353,20 +353,21 @@ class PBQModule(SchedulerModule):
     def _steal_order(self, es: Any) -> list:
         order = self._order.get(id(es))
         if order is None:
-            from ..core.topology import core_of_stream, distance
             sibs = es.virtual_process.execution_streams
             n = len(sibs)
             me = sibs.index(es)
-            my_core = core_of_stream(es.th_id)
+            my_core = _topology.core_of_stream(es.th_id)
             idx = {id(s): i for i, s in enumerate(sibs)}
             # topology-near first (same LLC before cross-cache — the
             # hwloc distance matrix), ring distance as the tiebreak;
             # static per stream, so computed once and cached
             order = sorted(
                 (s for s in sibs if s is not es),
-                key=lambda s: (distance(my_core, core_of_stream(s.th_id)),
-                               min((idx[id(s)] - me) % n,
-                                   (me - idx[id(s)]) % n)))
+                key=lambda s: (
+                    _topology.distance(my_core,
+                                       _topology.core_of_stream(s.th_id)),
+                    min((idx[id(s)] - me) % n,
+                        (me - idx[id(s)]) % n)))
             self._order[id(es)] = order
         return order
 
@@ -390,7 +391,7 @@ class PBQModule(SchedulerModule):
                     continue
                 t = sib.sched_private.steal()
                 if t is not None:
-                    return t, 1 + d
+                    return t, min(1 + d, 98)   # 99 is the system sentinel
         vpq = es.virtual_process.sched_private
         with vpq.lock:
             if vpq.system:
@@ -472,15 +473,15 @@ class LHQModule(PBQModule):
 
     def install(self, context: Any) -> None:
         super().install(context)
-        from ..core.topology import core_of_stream, llc_group_of
         self._group: dict[int, Any] = {}   # id(es) -> its group buffer
         for vp in context.virtual_processes:
             # one group buffer per last-level cache represented among this
             # VP's streams (the real hwloc rung; a VP whose streams all
             # share one LLC gets one group — no artificial split)
             vpq = vp.sched_private
-            llcs = sorted({llc_group_of(core_of_stream(s.th_id))
-                           for s in vp.execution_streams})
+            llcs = sorted({_topology.llc_group_of(
+                _topology.core_of_stream(s.th_id))
+                for s in vp.execution_streams})
             vpq.llc_index = {llc: i for i, llc in enumerate(llcs)}
             vpq.groups = []
             for _g in llcs:
@@ -492,9 +493,9 @@ class LHQModule(PBQModule):
     def _group_of(self, es: Any):
         grp = self._group.get(id(es))
         if grp is None:
-            from ..core.topology import core_of_stream, llc_group_of
             vpq = es.virtual_process.sched_private
-            g = vpq.llc_index[llc_group_of(core_of_stream(es.th_id))]
+            g = vpq.llc_index[_topology.llc_group_of(
+                _topology.core_of_stream(es.th_id))]
             grp = vpq.groups[g]
             self._group[id(es)] = grp
         return grp
@@ -513,18 +514,22 @@ class LHQModule(PBQModule):
                 priority=lambda x: x.priority)
             if t is not None:
                 return t, 0
-            grp = self._group_of(es)
-            t = grp.try_pop_best(priority=lambda x: x.priority)
+            my_grp = self._group_of(es)
+            # the stream's OWN hierarchy: its buffer's spill target is not
+            # another stream's queue, so this is distance 0 (not a steal)
+            t = my_grp.try_pop_best(priority=lambda x: x.priority)
             if t is not None:
-                return t, 1
+                return t, 0
             for d, sib in enumerate(self._steal_order(es)):
                 if sib.sched_private is None:
                     continue
                 t = sib.sched_private.steal()
                 if t is not None:
-                    return t, 2 + d
+                    return t, min(1 + d, 98)
             vpq = es.virtual_process.sched_private
             for grp in vpq.groups:
+                if grp is my_grp:
+                    continue    # already drained above; a re-pop is no steal
                 t = grp.steal()
                 if t is not None:
                     return t, 10

@@ -34,7 +34,8 @@ def param():
     saved = {}
 
     def set_(name, value):
-        saved[name] = params.get(name)
+        if name not in saved:       # keep the ORIGINAL for restore when a
+            saved[name] = params.get(name)   # test overrides twice
         params.set(name, value)
 
     yield set_

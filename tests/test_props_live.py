@@ -107,3 +107,45 @@ def test_custom_property_and_sde_in_snapshot(param):
         assert sde.get("app::custom") >= 3
     finally:
         properties.unregister("app", "phase")
+
+
+def test_dashboard_renders_snapshot():
+    """The aggregator_visu consumer: a snapshot becomes a readable table
+    with one column per rank namespace and sde dicts expanded to rows."""
+    from parsec_tpu.prof.dashboard import render_snapshot
+    snap = {"ts": 1000.0, "props": {
+        "rank0": {"sched_pending": 3, "nb_tasks": 7,
+                  "sde": {"parsec::steals": 2}},
+        "rank1": {"sched_pending": 0, "nb_tasks": 4,
+                  "sde": {"parsec::steals": 9}},
+    }}
+    text = render_snapshot(snap)
+    assert "rank0" in text and "rank1" in text
+    assert "sched_pending" in text and "sde:parsec::steals" in text
+    lines = text.splitlines()
+    row = next(l for l in lines if l.startswith("nb_tasks"))
+    assert "7" in row and "4" in row
+
+
+def test_dashboard_watch_live(tmp_path, param):
+    """watch() renders frames from the live stream while a pool runs."""
+    import io
+    from parsec_tpu.prof.dashboard import watch
+    path = str(tmp_path / "props.json")
+    param("props_stream", path)
+    param("props_stream_interval", 0.02)
+    V = VectorTwoDimCyclic("V", lm=4, mb=4,
+                           init_fn=lambda m, size: np.zeros(size))
+    tp = _slow_chain(V, nt=6, delay=0.03)
+    ctx = Context(nb_cores=1)
+    try:
+        ctx.add_taskpool(tp)
+        ctx.start()              # opens the props stream
+        time.sleep(0.15)
+        buf = io.StringIO()
+        watch(path, interval=0.02, iterations=3, out=buf)
+        ctx.wait(timeout=60)
+    finally:
+        ctx.fini()
+    text = buf.getvalue()
+    assert "rank0" in text and "sched_pending" in text
