@@ -442,22 +442,33 @@ def bench_dispatch_us(ntasks: int = 2000) -> float:
     return statistics.median(times) / (NT * DEPTH) * 1e6
 
 
+def _staged(name, fn, *a, **kw):
+    """Run one bench stage, logging its wall to stderr (progress trace for
+    long driver runs; stdout stays the single JSON line)."""
+    import sys
+    t0 = time.perf_counter()
+    out = fn(*a, **kw)
+    print(f"[bench] {name}: {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr, flush=True)
+    return out
+
+
 def main() -> None:
     import os
     n = int(os.environ.get("BENCH_N", "16384"))
     # order matters for measurement quality: host-only metrics first, then
     # the small device programs, and the headline GEMM dead last — its
     # ~1.5GB store set fragments HBM and perturbs whatever follows it
-    dispatch_us = bench_dispatch_us()
+    dispatch_us = _staged("dispatch", bench_dispatch_us)
     from parsec_tpu.models.stencil import run_stencil_bench
-    stencil = run_stencil_bench()   # the testing_stencil_1D.c harness
-    lsten = bench_lowered_stencil_gflops()
-    lchol = bench_lowered_cholesky_gflops()
-    dyn = bench_dynamic_gemm_gflops()
-    dtd = bench_dtd_gemm_tpu()
-    chol = bench_dynamic_cholesky_gflops()
-    raw = bench_raw_dot_gflops(n=n)
-    gemm = bench_gemm_gflops(n=n)
+    stencil = _staged("stencil", run_stencil_bench)
+    lsten = _staged("lowered_stencil", bench_lowered_stencil_gflops)
+    lchol = _staged("lowered_cholesky", bench_lowered_cholesky_gflops)
+    dyn = _staged("dynamic_gemm", bench_dynamic_gemm_gflops)
+    dtd = _staged("dtd_gemm", bench_dtd_gemm_tpu)
+    chol = _staged("dynamic_cholesky", bench_dynamic_cholesky_gflops)
+    raw = _staged("raw_dot", bench_raw_dot_gflops, n=n)
+    gemm = _staged("gemm", bench_gemm_gflops, n=n)
     target = 0.70 * gemm["peak_gflops"]
     print(json.dumps({
         "metric": "ptg_tiled_gemm_gflops_per_chip",

@@ -1,0 +1,123 @@
+"""Reference app tier: generalized binomial-tree reduction + pingpong
+(tests/apps/generalized_reduction/BT_reduction.jdf, pingpong/rtt.jdf).
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm import run_multirank
+from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
+from parsec_tpu.models.pingpong import run_pingpong
+from parsec_tpu.models.reduction import (bt_reduction_ptg, count_bits,
+                                         index_to_tree, local_index,
+                                         tree_bit, tree_offset)
+from parsec_tpu.runtime import Context
+
+
+# ---------------------------------------------------------------------------
+# forest arithmetic (count_bits / compute_offset family)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 7, 8, 12, 13, 21, 32, 100])
+def test_forest_decomposition_covers_indices(n):
+    """Every leaf index lands in exactly one tree at a consistent local
+    position; tree sizes are the set bits of n."""
+    T = count_bits(n)
+    sizes = [1 << tree_bit(n, t) for t in range(1, T + 1)]
+    assert sum(sizes) == n
+    offs = [tree_offset(n, t) for t in range(1, T + 1)]
+    assert offs == sorted(offs)
+    for i in range(n):
+        t = index_to_tree(n, i)
+        li = local_index(n, i)
+        assert 1 <= t <= T
+        assert 0 <= li < sizes[t - 1]
+        assert offs[t - 1] + li == i
+
+
+def _vec(nt, nranks=1, rank=0, mb=4, seed=0):
+    rng = np.random.default_rng(seed)
+    base = rng.standard_normal((nt, mb)).astype(np.float32)
+    V = VectorTwoDimCyclic("A", lm=nt * mb, mb=mb, P=nranks, myrank=rank,
+                           init_fn=lambda m, size: base[m, :size].copy())
+    return base, V
+
+
+@pytest.mark.parametrize("nt", [1, 2, 3, 5, 8, 13, 16, 21])
+def test_bt_reduction_sums(nt):
+    """The forest reduces NT tiles to their sum in A(0) — every NT shape
+    (pure power of 2, odd, multi-tree)."""
+    base, V = _vec(nt, seed=nt)
+    tp = bt_reduction_ptg(V)
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+    got = np.asarray(V.data_of(0).newest_copy().value)
+    np.testing.assert_allclose(got, base.sum(axis=0), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_bt_reduction_custom_op():
+    base, V = _vec(8, seed=3)
+    tp = bt_reduction_ptg(V, op=np.maximum)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+    got = np.asarray(V.data_of(0).newest_copy().value)
+    np.testing.assert_allclose(got, base.max(axis=0), rtol=1e-6)
+
+
+def _reduc_rank_body(ctx, rank, nranks):
+    nt = 13
+    base, V = _vec(nt, nranks=nranks, rank=rank, seed=9)
+    tp = bt_reduction_ptg(V)
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=180)
+    ctx.comm_barrier()
+    if V.rank_of(0) == rank:
+        got = np.asarray(V.data_of(0).newest_copy().value)
+        np.testing.assert_allclose(got, base.sum(axis=0), rtol=1e-4,
+                                   atol=1e-5)
+    return True
+
+
+def test_bt_reduction_multirank():
+    assert all(run_multirank(4, _reduc_rank_body))
+
+
+# ---------------------------------------------------------------------------
+# pingpong
+# ---------------------------------------------------------------------------
+
+def test_pingpong_single_rank():
+    _, V = _vec(1, mb=2)
+    V.data_of(0).newest_copy().value[...] = 0.0
+    with Context(nb_cores=0) as ctx:
+        res = run_pingpong(ctx, V, nt=16)
+    assert res["hops"] == 16 and res["us_per_hop"] > 0
+    got = np.asarray(V.data_of(0).newest_copy().value)
+    np.testing.assert_allclose(got, 16.0)
+
+
+def _ping_rank_body(ctx, rank, nranks):
+    nt, mb = 24, 2
+    V = VectorTwoDimCyclic("A", lm=nranks * mb, mb=mb, P=nranks,
+                           myrank=rank,
+                           init_fn=lambda m, size: np.zeros(size,
+                                                            np.float32))
+    res = run_pingpong(ctx, V, nt)
+    ctx.comm_barrier()
+    # rank r's home tile holds the chain state after its LAST hop:
+    # max{k < nt : k % nranks == r} + 1 increments
+    last = max(k for k in range(nt) if k % nranks == rank)
+    got = np.asarray(V.data_of(rank).newest_copy().value)
+    np.testing.assert_allclose(got, float(last + 1))
+    return res["us_per_hop"]
+
+
+@pytest.mark.parametrize("nranks", [2, 4])
+def test_pingpong_multirank(nranks):
+    """The rtt shape: every hop crosses ranks; the chain state lands on
+    each rank's home tile at its last visit."""
+    rtts = run_multirank(nranks, _ping_rank_body)
+    assert all(r > 0 for r in rtts)
