@@ -442,33 +442,78 @@ def bench_dispatch_us(ntasks: int = 2000) -> float:
     return statistics.median(times) / (NT * DEPTH) * 1e6
 
 
-def _staged(name, fn, *a, **kw):
+def _staged(name, fn, *a, retries=1, **kw):
     """Run one bench stage, logging its wall to stderr (progress trace for
-    long driver runs; stdout stays the single JSON line)."""
+    long driver runs; stdout stays the single JSON line).
+
+    The PJRT relay drops connections now and then (remote_compile body
+    truncation, transfer resets); one flaky stage must not kill the whole
+    bench — retry, then degrade to an error record so every other metric
+    still reports."""
     import sys
-    t0 = time.perf_counter()
-    out = fn(*a, **kw)
-    print(f"[bench] {name}: {time.perf_counter() - t0:.1f}s",
-          file=sys.stderr, flush=True)
-    return out
+    for attempt in range(retries + 1):
+        t0 = time.perf_counter()
+        try:
+            out = fn(*a, **kw)
+        except Exception as e:
+            print(f"[bench] {name}: attempt {attempt + 1} failed "
+                  f"({type(e).__name__}: {e})", file=sys.stderr, flush=True)
+            if attempt >= retries:
+                return {"gflops": 0.0, "error": f"{type(e).__name__}: {e}"}
+            continue
+        print(f"[bench] {name}: {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr, flush=True)
+        return out
 
 
 def main() -> None:
     import os
+    import sys
     n = int(os.environ.get("BENCH_N", "16384"))
+    # secondary-stage wall budget: relay weather varies 10x between runs
+    # (compiles and transfers ride a shared tunnel); once the budget is
+    # spent the remaining SECONDARY stages are skipped so the headline
+    # always reports within the driver's patience
+    budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+    t_start = time.perf_counter()
+
+    def secondary(name, fn, *a, **kw):
+        if time.perf_counter() - t_start > budget:
+            print(f"[bench] {name}: SKIPPED (over {budget:.0f}s budget)",
+                  file=sys.stderr, flush=True)
+            return {"gflops": 0.0, "skipped": "bench budget exhausted"}
+        return _staged(name, fn, *a, **kw)
+
     # order matters for measurement quality: host-only metrics first, then
     # the small device programs, and the headline GEMM dead last — its
     # ~1.5GB store set fragments HBM and perturbs whatever follows it
     dispatch_us = _staged("dispatch", bench_dispatch_us)
     from parsec_tpu.models.stencil import run_stencil_bench
-    stencil = _staged("stencil", run_stencil_bench)
-    lsten = _staged("lowered_stencil", bench_lowered_stencil_gflops)
-    lchol = _staged("lowered_cholesky", bench_lowered_cholesky_gflops)
-    dyn = _staged("dynamic_gemm", bench_dynamic_gemm_gflops)
-    dtd = _staged("dtd_gemm", bench_dtd_gemm_tpu)
-    chol = _staged("dynamic_cholesky", bench_dynamic_cholesky_gflops)
-    raw = _staged("raw_dot", bench_raw_dot_gflops, n=n)
-    gemm = _staged("gemm", bench_gemm_gflops, n=n)
+    stencil = secondary("stencil", run_stencil_bench)
+    lsten = secondary("lowered_stencil", bench_lowered_stencil_gflops)
+    lchol = secondary("lowered_cholesky", bench_lowered_cholesky_gflops)
+    dyn = secondary("dynamic_gemm", bench_dynamic_gemm_gflops)
+    dtd = secondary("dtd_gemm", bench_dtd_gemm_tpu)
+    chol = secondary("dynamic_cholesky", bench_dynamic_cholesky_gflops)
+    raw = secondary("raw_dot", bench_raw_dot_gflops, n=n)
+    gemm = _staged("gemm", bench_gemm_gflops, n=n, retries=2)
+    if not isinstance(dispatch_us, float):
+        dispatch_us = -1.0              # stage degraded
+    if "error" in gemm:                 # headline unobtainable: report the
+        gemm.update(peak_gflops=1.0, pct_peak=0.0,   # failure, not nothing
+                    device_kind="error", n=n, nb=0, seconds=0.0,
+                    lowering=gemm["error"])
+    # a degraded stage must be DISTINGUISHABLE from a measured zero in
+    # the one-line JSON: name -> why, for every stage that errored/skipped
+    degraded = {nm: d.get("error") or d.get("skipped")
+                for nm, d in (("stencil", stencil),
+                              ("lowered_stencil", lsten),
+                              ("lowered_cholesky", lchol),
+                              ("dynamic_gemm", dyn), ("dtd_gemm", dtd),
+                              ("dynamic_cholesky", chol), ("raw_dot", raw),
+                              ("gemm", gemm))
+                if isinstance(d, dict) and (d.get("error")
+                                            or d.get("skipped"))}
     target = 0.70 * gemm["peak_gflops"]
     print(json.dumps({
         "metric": "ptg_tiled_gemm_gflops_per_chip",
@@ -495,6 +540,7 @@ def main() -> None:
             "lowered_cholesky_n": lchol.get("n", 0),
             "stencil_gflops": round(stencil.get("gflops", 0.0), 2),
             "lowered_stencil_gflops": round(lsten.get("gflops", 0.0), 1),
+            **({"degraded_stages": degraded} if degraded else {}),
         },
     }))
 
