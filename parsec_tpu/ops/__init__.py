@@ -1,5 +1,5 @@
 """Kernel library: XLA/Pallas incarnations for task bodies."""
 
-from . import gemm
+from . import gemm, stencil
 
-__all__ = ["gemm"]
+__all__ = ["gemm", "stencil"]

@@ -1,0 +1,90 @@
+"""1-D stencil kernels: the VPU/bandwidth workhorse.
+
+Kernel incarnations for the stencil task bodies
+(``tests/apps/stencil/stencil_internal.h`` CORE_stencil_1D role):
+
+- :func:`stencil1d_xla` — the jnp tap loop, and the DEFAULT incarnation:
+  XLA fuses the taps into one pass (measured ~370 GB/s effective on v5e
+  — near half of HBM), so the model's traceable uses it.
+- :func:`stencil1d_pallas` — the hand-tiled alternative: each padded row
+  pipelines HBM→VMEM once and every tap accumulates on-chip with static
+  slices (see /opt/skills/guides/pallas_guide.md).  For shapes/epilogues
+  XLA fuses poorly — the same role :func:`ops.gemm.matmul_pallas` plays
+  beside the XLA matmul.  Falls back to interpret mode off-TPU and to
+  the XLA loop for rows too large to sit in VMEM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# rows larger than this (elements) stay on the XLA path: one 8-row block
+# (input + output + f32 accumulator, ~12 bytes/element/row) must fit VMEM
+# (~16 MB/core) with pipelining headroom
+_MAX_VMEM_ROW = 1 << 17
+
+
+def stencil1d_xla(padded: Any, weights: Any) -> Any:
+    """out[i] = sum_j w[j] * padded[i+j] over the interior (tap loop)."""
+    w = np.asarray(weights)
+    n = padded.shape[-1] - len(w) + 1
+    ct = jnp.result_type(padded.dtype, jnp.float32)
+    out = jnp.zeros(padded.shape[:-1] + (n,), ct)
+    for j in range(len(w)):
+        out = out + ct.type(float(w[j])) * padded[..., j:j + n].astype(ct)
+    return out.astype(padded.dtype)
+
+
+def _stencil_row_kernel(p_ref, o_ref, *, n: int, w: tuple):
+    # an 8-row block of padded rows sits VMEM-resident (Mosaic's sublane
+    # granularity): every tap is a static slice, all accumulation
+    # on-chip, one HBM read + one HBM write per row
+    ct = jnp.result_type(p_ref.dtype, jnp.float32)
+    acc = jnp.zeros((p_ref.shape[0], n), ct)
+    for j in range(len(w)):
+        acc = acc + ct.type(w[j]) * p_ref[:, j:j + n].astype(ct)
+    o_ref[:, :] = acc.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("weights", "interpret"))
+def _stencil1d_pallas_rows(padded: Any, weights: tuple,
+                           interpret: bool) -> Any:
+    from jax.experimental import pallas as pl
+
+    taps = len(weights)
+    b, npad = padded.shape
+    n = npad - taps + 1
+    bpad = (-b) % 8          # Mosaic sublane granularity
+    if bpad:
+        padded = jnp.pad(padded, ((0, bpad), (0, 0)))
+    b8 = b + bpad
+    out = pl.pallas_call(
+        functools.partial(_stencil_row_kernel, n=n, w=weights),
+        grid=(b8 // 8,),
+        in_specs=[pl.BlockSpec((8, npad), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, n), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b8, n), padded.dtype),
+        interpret=interpret,
+    )(padded)
+    return out[:b]
+
+
+def stencil1d_pallas(padded: Any, weights: Any,
+                     interpret: bool | None = None) -> Any:
+    """VMEM-resident stencil over ``padded`` (1-D or batched rows); the
+    last dim carries ``len(weights)-1`` halo elements, dropped in the
+    output."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if padded.shape[-1] > _MAX_VMEM_ROW:
+        return stencil1d_xla(padded, weights)
+    w = tuple(float(x) for x in np.asarray(weights))
+    lead = padded.shape[:-1]            # arbitrary leading dims, like xla
+    p2 = padded.reshape((-1, padded.shape[-1]))
+    out = _stencil1d_pallas_rows(p2, w, interpret)
+    return out.reshape(lead + (out.shape[-1],))
