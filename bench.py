@@ -271,6 +271,26 @@ def bench_dynamic_cholesky_gflops(n: int = 8192, nb: int = 1024) -> dict:
     }
 
 
+def _time_lowered(low, sync_store: str, reps: int = 3):
+    """Shared lowered-bench harness: device stores, jit, warm, then the
+    median of ``reps`` runs each synced by a device-side SCALAR read —
+    ``np.asarray(out)`` would drag the whole store through the TPU tunnel
+    and time the transfer (the round-3 bench bug this guards against).
+    Returns ``(median_seconds, last_out)``."""
+    import jax
+    st = {k: jax.device_put(v) for k, v in low.initial_stores().items()}
+    jf = jax.jit(low.step_fn)
+    out = jf(st)
+    _ = float(out[sync_store].reshape(-1)[0])    # compile + warm
+    times = []
+    for _i in range(reps):
+        t0 = time.perf_counter()
+        out = jf(st)
+        _ = float(out[sync_store].reshape(-1)[0])
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times), out
+
+
 def bench_lowered_cholesky_gflops(n: int = 16384, nb: int = 512) -> dict:
     """The compiled incarnation of the Cholesky PTG: four task classes,
     triangular space, batched per topological wavefront by the lowering —
@@ -280,7 +300,6 @@ def bench_lowered_cholesky_gflops(n: int = 16384, nb: int = 512) -> dict:
     by a device-side scalar read (np.asarray(out) would drag the whole
     factored matrix through the TPU tunnel and time the transfer, which is
     exactly the round-3 bench bug this replaces)."""
-    import jax
     import numpy as np
 
     from parsec_tpu.data_dist.matrix import SymTwoDimBlockCyclic
@@ -291,22 +310,36 @@ def bench_lowered_cholesky_gflops(n: int = 16384, nb: int = 512) -> dict:
     a = make_spd_fast(n)
     A = SymTwoDimBlockCyclic.from_dense("A", a, nb, nb)
     low = lower_taskpool(tiled_cholesky_ptg(A))
-    st = {k: jax.device_put(v) for k, v in low.initial_stores().items()}
-    jf = jax.jit(low.step_fn)
-    out = jf(st)
-    _ = float(out["A"].reshape(-1)[0])          # compile + warm
-    times = []
-    for _i in range(3):
-        t0 = time.perf_counter()
-        out = jf(st)
-        _ = float(out["A"].reshape(-1)[0])      # device-side slice sync
-        times.append(time.perf_counter() - t0)
-    t = statistics.median(times)
+    t, out = _time_lowered(low, "A")
     # spot-check the first tile against the dense factorization
     got = np.asarray(out["A"][0])
     expect = np.linalg.cholesky(a[:nb, :nb].astype(np.float64))
     err = float(np.max(np.abs(np.tril(got) - expect)))
     return {"gflops": cholesky_flops(n) / t / 1e9, "n": n, "nb": nb,
+            "seconds": t, "mode": low.mode, "tile00_abs_err": err}
+
+
+def bench_lowered_lu_gflops(n: int = 8192, nb: int = 512) -> dict:
+    """The compiled incarnation of the LU-nopiv PTG — the third dense
+    factorization through the wavefront pass (GETRF/TRSM_L/TRSM_U/GEMM,
+    square space): every panel's trailing update is one batched tile
+    matmul.  Scalar-read synced like the Cholesky stage."""
+    import numpy as np
+
+    from parsec_tpu.data_dist.matrix import TiledMatrix
+    from parsec_tpu.models.lu import lu_flops, make_dd, tiled_lu_ptg
+    from parsec_tpu.ptg.lowering import lower_taskpool
+
+    a = make_dd(n, seed=1).astype(np.float32)
+    A = TiledMatrix.from_dense("A", a.copy(), nb, nb)
+    low = lower_taskpool(tiled_lu_ptg(A))
+    t, out = _time_lowered(low, "A")
+    # spot-check tile (0,0): L\U packed must match the dense recursion
+    from parsec_tpu.models.lu import _getrf_nopiv_np
+    got = np.asarray(out["A"][0])
+    expect = _getrf_nopiv_np(a[:nb, :nb].astype(np.float64))
+    err = float(np.max(np.abs(got - expect)))
+    return {"gflops": lu_flops(n) / t / 1e9, "n": n, "nb": nb,
             "seconds": t, "mode": low.mode, "tile00_abs_err": err}
 
 
@@ -316,7 +349,6 @@ def bench_lowered_stencil_gflops(n: int = 1 << 24, mb: int = 1 << 18,
     T wavefronts, each ONE batched (2R+1)-tap update over all tiles, ghost
     reads as store gathers.  Memory-bound by design — the number measures
     how close the emitted program gets to HBM bandwidth."""
-    import jax
     import numpy as np
 
     from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
@@ -331,17 +363,7 @@ def bench_lowered_stencil_gflops(n: int = 1 << 24, mb: int = 1 << 18,
                            base[m * mb:m * mb + size])
     weights = np.full(2 * radius + 1, 1.0 / (2 * radius + 1))
     low = lower_taskpool(stencil_1d_ptg(V, weights, iterations))
-    st = {k: jax.device_put(v) for k, v in low.initial_stores().items()}
-    jf = jax.jit(low.step_fn)
-    out = jf(st)
-    _ = float(out["V"].reshape(-1)[0])
-    times = []
-    for _i in range(3):
-        t0 = time.perf_counter()
-        out = jf(st)
-        _ = float(out["V"].reshape(-1)[0])
-        times.append(time.perf_counter() - t0)
-    t = statistics.median(times)
+    t, out = _time_lowered(low, "V")
     # spot-check the first tile against the dense oracle
     got = np.asarray(out["V"][0])
     want = stencil_reference(base, weights, iterations)[:mb]
@@ -492,6 +514,7 @@ def main() -> None:
     stencil = secondary("stencil", run_stencil_bench)
     lsten = secondary("lowered_stencil", bench_lowered_stencil_gflops)
     lchol = secondary("lowered_cholesky", bench_lowered_cholesky_gflops)
+    llu = secondary("lowered_lu", bench_lowered_lu_gflops)
     dyn = secondary("dynamic_gemm", bench_dynamic_gemm_gflops)
     dtd = secondary("dtd_gemm", bench_dtd_gemm_tpu)
     chol = secondary("dynamic_cholesky", bench_dynamic_cholesky_gflops)
@@ -509,6 +532,7 @@ def main() -> None:
                 for nm, d in (("stencil", stencil),
                               ("lowered_stencil", lsten),
                               ("lowered_cholesky", lchol),
+                              ("lowered_lu", llu),
                               ("dynamic_gemm", dyn), ("dtd_gemm", dtd),
                               ("dynamic_cholesky", chol), ("raw_dot", raw),
                               ("gemm", gemm))
@@ -538,6 +562,7 @@ def main() -> None:
             "dynamic_cholesky_gflops": round(chol.get("gflops", 0.0), 1),
             "lowered_cholesky_gflops": round(lchol.get("gflops", 0.0), 1),
             "lowered_cholesky_n": lchol.get("n", 0),
+            "lowered_lu_gflops": round(llu.get("gflops", 0.0), 1),
             "stencil_gflops": round(stencil.get("gflops", 0.0), 2),
             "lowered_stencil_gflops": round(lsten.get("gflops", 0.0), 1),
             **({"degraded_stages": degraded} if degraded else {}),

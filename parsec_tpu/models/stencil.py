@@ -131,7 +131,7 @@ def stencil_1d_ptg(V: VectorTwoDimCyclic, weights: np.ndarray,
         # nothing here (ops/stencil.py carries the Pallas variant for
         # shapes XLA fuses poorly), the lowered program's cost lives in
         # the per-level store reshuffles instead
-        return stencil1d_xla(padded, np.asarray(Wd, ct)).astype(dt)
+        return stencil1d_xla(padded, Wd).astype(dt)
 
     from ..ptg.lowering import Traceable
     t.body(body, dyld="stencil1d")
