@@ -82,8 +82,11 @@ class TPUDeviceTask:
         self.es = es
         self.task = task
         self.submit = submit
-        self.stage_in = None     # user-overridable hooks (device_gpu.h:61-77)
-        self.stage_out = None
+        # user transfer overrides (the stage_custom.jdf contract,
+        # device_gpu.h:61-77) — read HERE so every construction site
+        # (enqueue and scheduler flooding alike) honors them
+        self.stage_in = getattr(task.task_class, "stage_in_hook", None)
+        self.stage_out = getattr(task.task_class, "stage_out_hook", None)
         self.flow_sizes = None
 
 
@@ -526,6 +529,9 @@ class TPUDevice(Device):
         from ..ptg.lowering import find_traceable
 
         tc = batch[0].task.task_class
+        if any(d.stage_in is not None or d.stage_out is not None
+               for d in batch):
+            return False   # custom stage hooks own data placement
         dyld = next((c.dyld for c in tc.chores
                      if c.device_type == self.type and c.dyld), None)
         if dyld is None:

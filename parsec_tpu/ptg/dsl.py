@@ -144,6 +144,8 @@ class TaskClassBuilder:
         self._hash_struct: Any = None
         self._startup: Callable | None = None
         self._simcost: Callable | None = None
+        self._stage_in_hook: Callable | None = None
+        self._stage_out_hook: Callable | None = None
 
     # -- structure ----------------------------------------------------------
     def affinity(self, collection: Any, key_fn: Callable) -> "TaskClassBuilder":
@@ -206,6 +208,17 @@ class TaskClassBuilder:
         pool then tracks ``largest_simulation_date`` (PARSEC_SIM model)."""
         g_ns = self._ptg._g_ns
         self._simcost = lambda locals_: fn(g_ns(), _ns(locals_))
+        return self
+
+    def stage_hooks(self, stage_in: Callable | None = None,
+                    stage_out: Callable | None = None
+                    ) -> "TaskClassBuilder":
+        """User transfer hooks for this class's device tasks
+        (``stage_custom.jdf`` role, ``device_gpu.h:61-77``): each is
+        ``fn(device, task)`` replacing the default versioned stage-in /
+        stage-out around the device dispatch."""
+        self._stage_in_hook = stage_in
+        self._stage_out_hook = stage_out
         return self
 
     def body(self, fn: Callable | None = None, device: str = "cpu",
@@ -297,7 +310,7 @@ class TaskClassBuilder:
         yield from rec(0, {})
 
     def _build(self) -> TaskClass:
-        return TaskClass(
+        tc = TaskClass(
             self.name,
             params=list(self.param_ranges),
             flows=[fb._build() for fb in self._flows],
@@ -311,6 +324,13 @@ class TaskClassBuilder:
             startup_fn=self._startup,
             simcost=self._simcost,
         )
+        # device-task transfer overrides ride as plain attributes (the
+        # device module reads them per dispatch; absent = defaults)
+        if self._stage_in_hook is not None:
+            tc.stage_in_hook = self._stage_in_hook
+        if self._stage_out_hook is not None:
+            tc.stage_out_hook = self._stage_out_hook
+        return tc
 
 
 class PTGTaskpool(Taskpool):

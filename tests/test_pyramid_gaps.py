@@ -1,0 +1,166 @@
+"""Test-pyramid round-out (SURVEY §4 items without a prior analog):
+custom device stage hooks (``stage_custom.jdf``), DTD allreduce
+(``dtd_test_allreduce.c``), and the independent-chain scheduler stress
+(``multichain.jdf``).
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from parsec_tpu import ptg
+from parsec_tpu.data_dist.matrix import TiledMatrix
+from parsec_tpu.device import registry
+from parsec_tpu.device.tpu import TPUDevice
+from parsec_tpu.dtd import DTDTaskpool, INOUT, INPUT, OUTPUT
+from parsec_tpu.runtime import Context
+
+
+@pytest.fixture
+def accel_device():
+    snapshot = list(registry.devices)
+    dev = TPUDevice(jax.devices()[0])
+    registry.add(dev)
+    yield dev
+    registry.devices = snapshot
+    for i, d in enumerate(registry.devices):
+        d.device_index = i
+
+
+# ---------------------------------------------------------------------------
+# custom stage hooks (stage_custom.jdf / device_gpu.h:61-77)
+# ---------------------------------------------------------------------------
+
+def test_custom_stage_hooks_drive_transfers(accel_device):
+    """A class's stage_in_hook/stage_out_hook replace the default
+    versioned staging: the custom stage-in doubles the tile on the way to
+    the device, the custom stage-out records itself, and the vmapped
+    batch path stands aside (custom hooks own data placement)."""
+    calls = {"in": 0, "out": 0}
+    n, nb = 32, 16
+    a = np.random.default_rng(0).standard_normal((n, n)).astype(np.float32)
+    A = TiledMatrix.from_dense("A", a.copy(), nb, nb)
+
+    def my_stage_in(device, task):
+        calls["in"] += 1
+        import jax as _jax
+        c = task.data[0]
+        # custom transfer: land the tile on the device DOUBLED (a stand-in
+        # for any user-owned packing/layout logic)
+        c.value = _jax.device_put(np.asarray(c.value) * 2.0,
+                                  device.jax_device)
+
+    def my_stage_out(device, task):
+        calls["out"] += 1
+
+    p = ptg.PTGBuilder("stagec", A=A, MT=A.mt, NT=A.nt)
+    t = p.task("T",
+               m=ptg.span(0, lambda g, l: g.MT - 1),
+               n=ptg.span(0, lambda g, l: g.NT - 1))
+    f = t.flow("X", ptg.RW)
+    f.input(data=("A", lambda g, l: (l.m, l.n)))
+    f.output(data=("A", lambda g, l: (l.m, l.n)))
+    t.stage_hooks(stage_in=my_stage_in, stage_out=my_stage_out)
+
+    def body(es, task, device):
+        c = task.data[0]
+        c.value = c.value + 1.0
+        c.version += 1
+        return c.value
+
+    t.body(body, device="tpu")
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(p.build())
+        ctx.wait(timeout=60)
+    accel_device.sync()
+    accel_device.flush_cache()
+    ntiles = A.mt * A.nt
+    assert calls["in"] == ntiles and calls["out"] == ntiles
+    np.testing.assert_allclose(A.to_dense(), 2.0 * a + 1.0, rtol=1e-5)
+
+
+def test_lowering_refuses_stage_hooked_classes():
+    """Custom stage hooks own data placement: the compiled lowering must
+    refuse (fall back dynamic), never silently drop the user's transfer
+    logic."""
+    from parsec_tpu.models.tiled_gemm import tiled_gemm_ptg
+    from parsec_tpu.ptg.lowering import LoweringError, lower_taskpool
+    n, nb = 32, 16
+    a = np.ones((n, n), np.float32)
+    A = TiledMatrix.from_dense("A", a, nb, nb)
+    B = TiledMatrix.from_dense("B", a, nb, nb)
+    C = TiledMatrix("C", n, n, nb, nb)
+    tp = tiled_gemm_ptg(A, B, C)
+    tp.task_class("GEMM").stage_in_hook = lambda device, task: None
+    with pytest.raises(LoweringError, match="stage hooks"):
+        lower_taskpool(tp)
+
+
+# ---------------------------------------------------------------------------
+# DTD allreduce (dtd_test_allreduce.c)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("k,nb_cores", [(4, 0), (7, 2), (16, 2)])
+def test_dtd_allreduce(k, nb_cores):
+    """Reduce K tiles into tile 0, then broadcast the result back: after
+    the pool drains every tile holds the elementwise sum of all K."""
+    rng = np.random.default_rng(k)
+    arrs = [rng.standard_normal(8).astype(np.float32) for _ in range(k)]
+    want = np.sum(arrs, axis=0)
+
+    def add_into(acc, x):
+        acc[...] += x
+
+    def copy_from(dst, src):
+        dst[...] = src
+
+    with Context(nb_cores=nb_cores) as ctx:
+        tp = DTDTaskpool("allreduce")
+        ctx.add_taskpool(tp)
+        tiles = [tp.tile_of_array(a, key=("t", i))
+                 for i, a in enumerate(arrs)]
+        for i in range(1, k):
+            tp.insert_task(add_into, (tiles[0], INOUT), (tiles[i], INPUT),
+                           name="REDUCE")
+        for i in range(1, k):
+            tp.insert_task(copy_from, (tiles[i], OUTPUT),
+                           (tiles[0], INPUT), name="BCAST")
+        tp.wait(timeout=120)
+    for a in arrs:
+        np.testing.assert_allclose(a, want, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# multichain (multichain.jdf): independent chains racing the scheduler
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sched", ["lfq", "ltq", "lhq"])
+def test_multichain_ordering(sched):
+    """NT independent chains of DEPTH tasks: every chain executes in
+    order whatever the scheduler interleaves across workers."""
+    NT, DEPTH = 8, 24
+    seen: list[list[int]] = [[] for _ in range(NT)]
+
+    p = ptg.PTGBuilder("multichain", NT=NT, D=DEPTH)
+    t = p.task("T",
+               c=ptg.span(0, lambda g, l: g.NT - 1),
+               d=ptg.span(0, lambda g, l: g.D - 1))
+    f = t.flow("ctl", ptg.CTL)
+    f.input(pred=("T", "ctl", lambda g, l: {"c": l.c, "d": l.d - 1}),
+            guard=lambda g, l: l.d > 0)
+    f.output(succ=("T", "ctl", lambda g, l: {"c": l.c, "d": l.d + 1}),
+             guard=lambda g, l: l.d < g.D - 1)
+
+    def body(es, task, g, l):
+        seen[l.c].append(l.d)
+        if l.d % 7 == 0:
+            time.sleep(0.001)     # jitter the interleaving
+
+    t.body(body)
+    with Context(nb_cores=4, scheduler=sched) as ctx:
+        ctx.add_taskpool(p.build())
+        ctx.wait(timeout=60)
+    for c in range(NT):
+        assert seen[c] == list(range(DEPTH)), f"chain {c} out of order"
