@@ -438,6 +438,9 @@ class TPUDevice(Device):
         first = batch[0]
         es = first.es
         tc = first.task.task_class
+        if (getattr(tc, "stage_in_hook", None) is not None
+                or getattr(tc, "stage_out_hook", None) is not None):
+            return   # custom staging forces per-task dispatch: no point
         dyld = next((c.dyld for c in tc.chores
                      if c.device_type == self.type and c.dyld), None)
         if dyld is None or find_traceable(dyld) is None:

@@ -1,5 +1,5 @@
 """Library taskpools / flagship applications built on the runtime."""
 
-from . import irregular, pingpong, reduction, tiled_gemm
+from . import irregular, pingpong, reduction, stencil2d, tiled_gemm
 
-__all__ = ["irregular", "pingpong", "reduction", "tiled_gemm"]
+__all__ = ["irregular", "pingpong", "reduction", "stencil2d", "tiled_gemm"]

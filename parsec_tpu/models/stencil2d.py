@@ -1,0 +1,176 @@
+"""2-D 5-point stencil as a PTG taskpool — BASELINE.json staged config #2.
+
+The 2-D analog of :mod:`parsec_tpu.models.stencil` (and of the reference's
+ghost-exchange app tier): each iteration every (mb, nb) tile exchanges
+radius-1 ghost ROWS with its north/south neighbors and ghost COLUMNS with
+its east/west neighbors, then applies the 5-point update
+
+    out = wc*c + wn*north(c) + ws*south(c) + we*east(c) + ww*west(c)
+
+with zero boundaries.  Across ranks (a P x Q tile grid) the four ghost
+flows ride the remote-dep protocol — the 2-D halo pattern whose
+collectives shape a pod's nearest-neighbor ICI traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from .. import ptg
+from ..data.data import data_create
+
+
+def stencil_2d_ptg(M: Any, weights: Any, iterations: int) -> ptg.PTGTaskpool:
+    """Build ST(t, i, j) over the tiles of ``M``.
+
+    ``weights`` = (wc, wn, ws, we, ww).  Flows: C chained over t; N/S/E/W
+    read the previous iteration's neighbor tiles (halo); boundaries are
+    zero-padded.  Matches :func:`stencil2d_reference`.
+    """
+    MT, NT = M.mt, M.nt
+    w = tuple(float(x) for x in weights)
+    assert len(w) == 5
+
+    # t == 0 reads snapshot M (double-buffer discipline, same reasoning as
+    # the 1-D model: a T==1 writeback must not race generation-0 reads)
+    from ..data_dist.collection import DictCollection
+    M0 = DictCollection(
+        name=M.name + "_0",
+        init_fn=lambda i, j: np.array(
+            np.asarray(M.data_of(i, j).newest_copy().value)),
+        nodes=M.nodes, myrank=M.myrank,
+        rank_of_fn=lambda i, j: M.rank_of(i, j),
+        keys=[(i, j) for i in range(MT) for j in range(NT)])
+
+    p = ptg.PTGBuilder("stencil2d", M=M, M0=M0, MT=MT, NT=NT,
+                       T=iterations, W=w)
+    t = p.task("ST",
+               t=ptg.span(0, lambda g, l: g.T - 1),
+               i=ptg.span(0, lambda g, l: g.MT - 1),
+               j=ptg.span(0, lambda g, l: g.NT - 1))
+    t.affinity("M", lambda g, l: (l.i, l.j))
+    t.priority(lambda g, l: g.T - l.t)
+
+    fc = t.flow("C", ptg.RW)
+    fc.input(data=("M0", lambda g, l: (l.i, l.j)),
+             guard=lambda g, l: l.t == 0)
+    fc.input(pred=("ST", "C",
+                   lambda g, l: {"t": l.t - 1, "i": l.i, "j": l.j}),
+             guard=lambda g, l: l.t > 0)
+    fc.output(succ=("ST", "C",
+                    lambda g, l: {"t": l.t + 1, "i": l.i, "j": l.j}),
+              guard=lambda g, l: l.t < g.T - 1)
+    # halo fan-out: this tile is next iteration's N/S/E/W ghost source
+    fc.output(succ=("ST", "N",
+                    lambda g, l: {"t": l.t + 1, "i": l.i + 1, "j": l.j}),
+              guard=lambda g, l: l.t < g.T - 1 and l.i < g.MT - 1)
+    fc.output(succ=("ST", "S",
+                    lambda g, l: {"t": l.t + 1, "i": l.i - 1, "j": l.j}),
+              guard=lambda g, l: l.t < g.T - 1 and l.i > 0)
+    fc.output(succ=("ST", "W",
+                    lambda g, l: {"t": l.t + 1, "i": l.i, "j": l.j + 1}),
+              guard=lambda g, l: l.t < g.T - 1 and l.j < g.NT - 1)
+    fc.output(succ=("ST", "E",
+                    lambda g, l: {"t": l.t + 1, "i": l.i, "j": l.j - 1}),
+              guard=lambda g, l: l.t < g.T - 1 and l.j > 0)
+    fc.output(data=("M", lambda g, l: (l.i, l.j)),
+              guard=lambda g, l: l.t == g.T - 1)
+
+    def _ghost(name, di, dj):
+        f = t.flow(name, ptg.READ)
+        f.input(data=("M0", lambda g, l: (l.i + di, l.j + dj)),
+                guard=lambda g, l: l.t == 0
+                and 0 <= l.i + di < g.MT and 0 <= l.j + dj < g.NT)
+        f.input(pred=("ST", "C",
+                      lambda g, l: {"t": l.t - 1, "i": l.i + di,
+                                    "j": l.j + dj}),
+                guard=lambda g, l: l.t > 0
+                and 0 <= l.i + di < g.MT and 0 <= l.j + dj < g.NT)
+        return f
+
+    _ghost("N", -1, 0)    # ghost row above comes from tile (i-1, j)
+    _ghost("S", +1, 0)
+    _ghost("W", 0, -1)
+    _ghost("E", 0, +1)
+
+    def body(es, task, g, l):
+        c = np.asarray(task.flow_data("C").value, np.float64)
+        h, wd = c.shape
+
+        def edge(fname, take):
+            v = task.flow_data(fname)
+            return None if v is None else np.asarray(
+                v.value, np.float64)[take]
+
+        nrow = edge("N", (slice(-1, None), slice(None)))   # their last row
+        srow = edge("S", (slice(0, 1), slice(None)))
+        wcol = edge("W", (slice(None), slice(-1, None)))
+        ecol = edge("E", (slice(None), slice(0, 1)))
+        pad = np.zeros((h + 2, wd + 2))
+        pad[1:-1, 1:-1] = c
+        if nrow is not None:
+            pad[0:1, 1:-1] = nrow
+        if srow is not None:
+            pad[-1:, 1:-1] = srow
+        if wcol is not None:
+            pad[1:-1, 0:1] = wcol
+        if ecol is not None:
+            pad[1:-1, -1:] = ecol
+        wc, wn, ws, we, ww = g.W
+        new = (wc * pad[1:-1, 1:-1] + wn * pad[:-2, 1:-1]
+               + ws * pad[2:, 1:-1] + ww * pad[1:-1, :-2]
+               + we * pad[1:-1, 2:])
+        # detach: neighbors still read this C as their ghost this round
+        task.set_flow_data("C", data_create(
+            new.astype(np.asarray(task.flow_data("C").value).dtype),
+            key=("st2", l.t, l.i, l.j)).get_copy(0))
+
+    # traceable incarnation for the wavefront lowering (None ghosts = zero
+    # boundary, exactly like the dynamic body)
+    def traceable(c, n_, s_, w_, e_):
+        import jax.numpy as jnp
+        dt = c.dtype
+        ct = jnp.result_type(dt, jnp.float32)
+        cw = c.astype(ct)
+        h, wd = cw.shape
+        pad = jnp.zeros((h + 2, wd + 2), ct)
+        pad = pad.at[1:-1, 1:-1].set(cw)
+        if n_ is not None:
+            pad = pad.at[0:1, 1:-1].set(n_[-1:, :].astype(ct))
+        if s_ is not None:
+            pad = pad.at[-1:, 1:-1].set(s_[0:1, :].astype(ct))
+        if w_ is not None:
+            pad = pad.at[1:-1, 0:1].set(w_[:, -1:].astype(ct))
+        if e_ is not None:
+            pad = pad.at[1:-1, -1:].set(e_[:, 0:1].astype(ct))
+        wc, wn, ws, we, ww = w
+        new = (wc * pad[1:-1, 1:-1] + wn * pad[:-2, 1:-1]
+               + ws * pad[2:, 1:-1] + ww * pad[1:-1, :-2]
+               + we * pad[1:-1, 2:])
+        return new.astype(dt)
+
+    from ..ptg.lowering import Traceable
+    t.body(body, dyld="stencil2d")
+    tp = p.build()
+    tp.local_traceables = {"stencil2d": Traceable(traceable)}
+    return tp
+
+
+def stencil2d_reference(x: np.ndarray, weights: Any,
+                        iterations: int) -> np.ndarray:
+    """Dense numpy oracle (zero boundaries)."""
+    wc, wn, ws, we, ww = (float(v) for v in weights)
+    x = np.asarray(x, np.float64)
+    for _ in range(iterations):
+        pad = np.zeros((x.shape[0] + 2, x.shape[1] + 2))
+        pad[1:-1, 1:-1] = x
+        x = (wc * pad[1:-1, 1:-1] + wn * pad[:-2, 1:-1]
+             + ws * pad[2:, 1:-1] + ww * pad[1:-1, :-2]
+             + we * pad[1:-1, 2:])
+    return x
+
+
+def stencil2d_flops(rows: int, cols: int, iterations: int) -> float:
+    return 2.0 * 5 * rows * cols * iterations

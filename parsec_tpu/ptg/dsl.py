@@ -216,9 +216,12 @@ class TaskClassBuilder:
         """User transfer hooks for this class's device tasks
         (``stage_custom.jdf`` role, ``device_gpu.h:61-77``): each is
         ``fn(device, task)`` replacing the default versioned stage-in /
-        stage-out around the device dispatch."""
-        self._stage_in_hook = stage_in
-        self._stage_out_hook = stage_out
+        stage-out around the device dispatch.  Only the arguments given
+        are updated — separate calls may set the two hooks."""
+        if stage_in is not None:
+            self._stage_in_hook = stage_in
+        if stage_out is not None:
+            self._stage_out_hook = stage_out
         return self
 
     def body(self, fn: Callable | None = None, device: str = "cpu",
