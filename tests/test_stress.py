@@ -59,3 +59,61 @@ def test_gemm_stress(param, nranks, cores, cthread, coal, sched):
     param("sched", sched)
     param("runtime_dag_compile", False)   # exercise the dynamic scheduler
     _check(run_multirank(nranks, _gemm_body, nb_cores=cores, timeout=240))
+
+
+# ---------------------------------------------------------------------------
+# round-4 feature interplay: recursive bodies + DTD discovery + live props
+# + steal accounting racing on one context
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("rep", range(3))
+def test_round4_features_race(param, tmp_path, rep):
+    """Recursive GEMM (nested pools) and body-driven DTD discovery run
+    CONCURRENTLY on one 4-worker context while the properties stream
+    writes snapshots and print_steals counts — the protocols must not
+    interfere (nested local-only pools, insert locks, PINS chains,
+    props registry)."""
+    from parsec_tpu.core.mca import repository
+    from parsec_tpu.data_dist.matrix import TiledMatrix
+    from parsec_tpu.dtd import DTDTaskpool
+    from parsec_tpu.models.irregular import (haar_project_dtd,
+                                             haar_project_reference)
+    from parsec_tpu.models.tiled_gemm import tiled_gemm_recursive_ptg
+    from parsec_tpu.runtime import Context
+
+    param("props_stream", str(tmp_path / f"props{rep}.json"))
+    param("props_stream_interval", 0.02)
+    param("runtime_dag_compile", False)
+    comp = repository.find("pins", "print_steals")
+    mod = comp.open()
+    try:
+        rng = np.random.default_rng(rep)
+        n, nb = 32, 8
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        b = rng.standard_normal((n, n)).astype(np.float32)
+        c = rng.standard_normal((n, n)).astype(np.float32)
+        A = TiledMatrix.from_dense("A", a.copy(), nb, nb)
+        B = TiledMatrix.from_dense("B", b.copy(), nb, nb)
+        C = TiledMatrix.from_dense("C", c.copy(), nb, nb)
+        with Context(nb_cores=4) as ctx:
+            rec = tiled_gemm_recursive_ptg(A, B, C, sub_mb=4, sub_nb=4)
+            ctx.add_taskpool(rec)
+            dtd = DTDTaskpool(f"haar{rep}")
+            ctx.add_taskpool(dtd)
+            tree = haar_project_dtd(dtd, 1.0, 1e-4, min_depth=4,
+                                    max_depth=18)
+            dtd.wait(timeout=180)
+            ctx.wait(timeout=180)
+        np.testing.assert_allclose(C.to_dense(), c + a @ b, rtol=1e-3,
+                                   atol=1e-4)
+        want = haar_project_reference(1.0, 1e-4, min_depth=4, max_depth=18)
+        assert set(tree) == set(want)
+        # the observability protocols must have actually observed: the
+        # stream wrote snapshots and the steal counter saw the 4 workers
+        import json
+        snap = json.load(open(tmp_path / f"props{rep}.json"))
+        assert "props" in snap and any(
+            k.startswith("rank0") for k in snap["props"])
+        assert sum(mod.steals.values()) > 0
+    finally:
+        comp.close(mod)
