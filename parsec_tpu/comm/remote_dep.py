@@ -541,6 +541,9 @@ class RemoteDepEngine:
                 return
             succ_tc = tp.task_class(dep.target_class)
             for succ_locals in dep.each_target(t.locals):
+                if succ_tc.in_space is not None \
+                        and not succ_tc.in_space(succ_locals):
+                    continue   # generated bounds check, receiver side
                 rank = self._succ_rank(succ_tc, succ_locals)
                 if rank != self.my_rank:
                     continue

@@ -333,6 +333,52 @@ class TaskClassBuilder:
             tc.stage_in_hook = self._stage_in_hook
         if self._stage_out_hook is not None:
             tc.stage_out_hook = self._stage_out_hook
+
+        # execution-space membership (the generated bounds-check role):
+        # parameters validate in declaration order against their ranges.
+        # This sits on the release hot path (one call per successor edge),
+        # so locals-INDEPENDENT ranges — the overwhelmingly common case —
+        # are captured once at first use (range membership is O(1));
+        # dependent ranges re-evaluate in order.  Mutating the pool's
+        # globals after execution starts is outside the contract anyway.
+        g_ns = self._ptg._g_ns
+        ranges = self.param_ranges
+        cache: dict = {"static": None}
+
+        class _Poison:
+            def __getattr__(self, k):
+                raise LookupError(k)
+
+            def __getitem__(self, k):
+                raise LookupError(k)
+
+        def in_space(locals_: dict) -> bool:
+            st = cache["static"]
+            if st is None:
+                try:
+                    g = g_ns()
+                    poison = _Poison()
+                    st = tuple(rngfn(g, poison)
+                               for rngfn in ranges.values())
+                except Exception:
+                    st = False
+                cache["static"] = st
+            if st is not False:
+                for pname, r in zip(ranges, st):
+                    v = locals_.get(pname)
+                    if v is None or v not in r:
+                        return False
+                return True
+            g = g_ns()
+            partial: dict = {}
+            for pname, rngfn in ranges.items():
+                v = locals_.get(pname)
+                if v is None or v not in rngfn(g, _ns(partial)):
+                    return False
+                partial[pname] = v
+            return True
+
+        tc.in_space = in_space
         return tc
 
 

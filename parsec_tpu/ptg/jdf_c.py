@@ -1,0 +1,379 @@
+"""Mechanical C-syntax JDF ingestion: reference ``.jdf`` files, directly.
+
+The reference's JDF front-end lexes C expressions and splices C bodies
+(``parsec.l`` / ``parsec.y``); this repo's textual grammar
+(:mod:`parsec_tpu.ptg.jdf`) is Python-expression-based by design.  This
+module bridges them *mechanically*: :func:`convert_c_jdf` rewrites a
+C-syntax JDF's **structure** — globals, execution spaces, derived locals,
+affinities, guarded/ranged arrows, priorities, ``%option`` lines — into
+the Python-expression grammar, and :func:`load_c_jdf` parses the result.
+
+What converts:
+
+- C prologues/epilogues (``extern "C" %{ ... %}``) are dropped — they hold
+  includes and helper C functions; Python helpers go in ``bodies``/build
+  bindings instead.
+- ``%{ return EXPR; %}`` inline fragments become ``(EXPR)``.
+- Expressions: ``&&``/``||``/``!`` → ``and``/``or``/``not``; ``->`` struct
+  derefs become attribute access with a field map translating reference
+  descriptor fields to this repo's collections (``lmt``→``mt``,
+  ``super.myrank``→``myrank``, ...); bare ``/`` becomes floor division
+  (JDF index arithmetic is integral in C).
+- Globals: ``[type = "int"]``-style quoted props unquote;
+  pointer-to-descriptor types become ``[type = data]``; ``default=``
+  moves into the ``NAME = value`` position.  Collections referenced by
+  affinities/data arrows but declared only in C code are synthesized as
+  ``[type = data]`` globals.
+- ``<- NEW`` arrows gain ``[type = DTT_DEFAULT]``; bind ``DTT_DEFAULT``
+  to a :class:`~parsec_tpu.data.datatype.TileType` at ``build()``.
+- ``%option`` lines keep the options this grammar knows and drop the
+  rest (``no_taskpool_instance``, ``dynamic`` — process-model artifacts).
+
+What does NOT convert: **C task bodies**.  Pass ``bodies`` mapping task
+names to Python body source (flow names are in scope, like any JDF
+body); unmapped bodies become ``pass`` — structure-only ingestion, which
+is exactly what graph/protocol tests need.
+
+Out-of-space successor arrows (``(k < NT) ? T PING(k+1)`` at
+``k = NT-1``, ``rtt.jdf:16``) rely on the generated bounds check; the
+runtime's execution-space membership drop covers them.
+
+KNOWN LIMIT: jdf2c performs symbolic dataflow analysis that forwards
+*read chains* to their data origin — an input arrow
+``<- A FANOUT(r-1, t)`` whose predecessor flow is READ and declares no
+reciprocal output arrow (``a2a.jdf:58``) still resolves.  This
+converter is mechanical, not symbolic: such files need the reciprocal
+arrows made explicit (one line each) or the app rebuilt with them (as
+``models/irregular.all2all_ptg`` does).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from .jdf import JDF, parse_jdf
+
+# reference descriptor field -> this repo's collection attribute
+_FIELD_MAP = {
+    "super.myrank": "myrank",
+    "super.nodes": "nodes",
+    "super.mt": "mt",
+    "super.nt": "nt",
+    "lmt": "mt",
+    "lnt": "nt",
+    "llm": "lm",
+    "lln": "ln",
+}
+
+_KNOWN_OPTIONS = ("nb_local_tasks_fn", "termdet")
+
+
+def convert_expr(s: str, field_map: dict[str, str] | None = None) -> str:
+    """One C expression → Python expression (structure-level subset)."""
+    fm = dict(_FIELD_MAP)
+    if field_map:
+        fm.update(field_map)
+    s = s.replace("&&", " and ").replace("||", " or ")
+    s = re.sub(r"!(?![=])", " not ", s)
+    s = s.replace("->", ".")
+    for k, v in sorted(fm.items(), key=lambda kv: -len(kv[0])):
+        s = s.replace("." + k, "." + v)
+    # integral division (C semantics for the non-negative index math JDFs
+    # do); '//' stays itself
+    s = re.sub(r"(?<!/)/(?!/)", "//", s)
+    return re.sub(r"\s+", " ", s).strip()
+
+
+def _convert_inline(s: str, fm) -> str:
+    """``%{ return EXPR; %}`` fragments → ``(EXPR)``."""
+    return re.sub(
+        r"%\{\s*return\s+(.*?);\s*%\}",
+        lambda m: "(" + convert_expr(m.group(1), fm) + ")", s, flags=re.S)
+
+
+_RE_EXTERN = re.compile(r'extern\s+"C"\s*%\{.*?%\}', re.S)
+_RE_GLOBAL_C = re.compile(r"^(\w+)\s*\[(.*)\]\s*$")
+_RE_PROP_C = re.compile(r'(\w+)\s*=\s*(?:"([^"]*)"|(\S+))')
+
+
+def _convert_global(line: str, fm) -> str:
+    m = _RE_GLOBAL_C.match(line.strip())
+    if not m:
+        return line
+    name, props_src = m.group(1), m.group(2)
+    props = {k: (a or b)
+             for k, a, b in _RE_PROP_C.findall(props_src)}
+    ctype = props.get("type", "")
+    default = props.get("default")
+    if "*" in ctype or "matrix" in ctype or "collection" in ctype \
+            or "dist" in ctype:
+        out_type = "data"
+    elif any(t in ctype for t in ("int", "float", "double")):
+        out_type = "int" if "int" in ctype else "float"
+    else:
+        out_type = "object"
+    head = name if default is None else \
+        f"{name} = {convert_expr(default, fm)}"
+    return f"{head}  [type = {out_type}]"
+
+
+def convert_c_jdf(text: str, bodies: dict[str, str] | None = None,
+                  field_map: dict[str, str] | None = None) -> str:
+    """Rewrite a C-syntax JDF into the Python-expression grammar."""
+    bodies = bodies or {}
+    text = _RE_EXTERN.sub("", text)
+    # strip C comments OUTSIDE bodies later; blanket-strip block comments
+    # now (C-syntax files comment with /* */ everywhere, incl. body stubs)
+    text = re.sub(r"/\*.*?\*/", "", text, flags=re.S)
+    text = _convert_inline(text, field_map)
+
+    out: list[str] = []
+    lines = _merge_continuations(text.split("\n"))
+    i, n = 0, len(lines)
+    cur_task: str | None = None
+    seen_globals: set[str] = set()
+    task_names: set[str] = set()
+    data_used: set[str] = set()   # collections referenced anywhere
+
+    # pre-scan task names (a task header is NAME(params) on its own line
+    # with a following range line somewhere before a BODY)
+    for ln in lines:
+        m = re.match(r"^(\w+)\s*\(([\w\s,]*)\)\s*(?:\[.*\])?\s*$", ln.strip())
+        if m and ".." not in ln:
+            task_names.add(m.group(1))
+
+    while i < n:
+        raw = lines[i]
+        line = raw.strip()
+        if not line:
+            out.append("")
+            i += 1
+            continue
+        if line.startswith("%option"):
+            kept = [f"{k} = {v}" for k, v in
+                    re.findall(r"(\w+)\s*=\s*(\S+)", line)
+                    if k in _KNOWN_OPTIONS]
+            if kept:
+                out.append("%option " + "  ".join(kept))
+            i += 1
+            continue
+        if line == "BODY" or line.startswith("BODY"):
+            # swallow the C body; emit the Python body (or pass)
+            depth_body = []
+            i += 1
+            while i < n and lines[i].strip() != "END":
+                depth_body.append(lines[i])
+                i += 1
+            i += 1  # consume END
+            out.append("BODY")
+            body = bodies.get(cur_task or "", "pass")
+            for bl in body.split("\n"):
+                out.append("  " + bl)
+            out.append("END")
+            continue
+        m = re.match(r"^(\w+)\s*\(([\w\s,]*)\)\s*(\[.*\])?\s*$", line)
+        if m and ".." not in line and m.group(1) in task_names:
+            cur_task = m.group(1)
+            out.append(line)
+            i += 1
+            continue
+        if cur_task is None:
+            conv = _convert_global(line, field_map)
+            gm = re.match(r"^(\w+)", conv)
+            if gm:
+                seen_globals.add(gm.group(1))
+            out.append(conv)
+            i += 1
+            continue
+        # inside a task: ranges / derived / affinity / arrows / priority
+        if line.startswith(":"):
+            md = re.match(r"^:\s*(\w+)\s*\((.*)\)\s*$", line)
+            if md:
+                data_used.add(md.group(1))
+                out.append(f"  : {md.group(1)}"
+                           f"({convert_expr(md.group(2), field_map)})")
+            else:
+                out.append(line)
+            i += 1
+            continue
+        if line.startswith(";"):
+            out.append(f"  ; {convert_expr(line[1:], field_map)}")
+            i += 1
+            continue
+        if line.startswith("<-") or line.startswith("->") or \
+                re.match(r"^(RW|READ|WRITE|CTL)\s", line):
+            out.append("  " + _convert_arrow_line(line, field_map,
+                                                  task_names, data_used))
+            i += 1
+            continue
+        mr = re.match(r"^(\w+)\s*=\s*(.+)$", line)
+        if mr:
+            parts = [p.strip() for p in mr.group(2).split("..")]
+            conv = " .. ".join(convert_expr(p, field_map) for p in parts)
+            out.append(f"  {mr.group(1)} = {conv}")
+            i += 1
+            continue
+        out.append(raw)
+        i += 1
+
+    # synthesize [type = data] globals for collections declared only in C
+    synth = [name for name in sorted(data_used)
+             if name not in seen_globals and name not in task_names]
+    header = [f"{name}  [type = data]" for name in synth]
+    body_text = "\n".join(out)
+    if "DTT_DEFAULT" in body_text and "DTT_DEFAULT" not in seen_globals:
+        # NEW arrows allocate at this type: bind a TileType at build()
+        header.append("DTT_DEFAULT  [type = object]")
+    return "\n".join(header + [body_text])
+
+
+def _merge_continuations(lines: list[str]) -> list[str]:
+    """Join lines whose ``[...]`` dep-property block spans several source
+    lines (the reference wraps long property lists); BODY regions are C
+    code and stay untouched."""
+    out: list[str] = []
+    i, n = 0, len(lines)
+    in_body = False
+    while i < n:
+        line = lines[i]
+        s = line.strip()
+        if in_body:
+            out.append(line)
+            if s == "END":
+                in_body = False
+            i += 1
+            continue
+        if s == "BODY" or s.startswith("BODY"):
+            in_body = True
+            out.append(line)
+            i += 1
+            continue
+        depth = line.count("[") - line.count("]")
+        while depth > 0 and i + 1 < n:
+            i += 1
+            nxt = lines[i]
+            line = line.rstrip() + " " + nxt.strip()
+            depth += nxt.count("[") - nxt.count("]")
+        out.append(line)
+        i += 1
+    return out
+
+
+def _convert_arrow_line(line: str, fm, task_names: set[str],
+                        data_used: set) -> str:
+    """Convert the expressions inside one flow/arrow line, preserving the
+    arrow structure the grammar shares with the reference."""
+    # split off a trailing [props] block (dep properties)
+    props = ""
+    pm = re.search(r"\[([^\]]*)\]\s*$", line)
+    if pm:
+        props_src = pm.group(1)
+        line = line[:pm.start()].rstrip()
+        kept = []
+        for k, a, b in _RE_PROP_C.findall(props_src):
+            kept.append(f"{k} = {a or b}")
+        if kept:
+            props = "  [" + "  ".join(kept) + "]"
+
+    def conv_target(t: str) -> str:
+        t = t.strip()
+        if t == "NEW":
+            return "NEW"       # [type=] appended at line level below
+        if t == "NULL":
+            return "NULL"
+        mt = re.match(r"^(\w+)\s+(\w+)\s*\((.*)\)$", t)
+        if mt:
+            args = ", ".join(
+                " .. ".join(convert_expr(p, fm) for p in a.split(".."))
+                for a in _split_args(mt.group(3)))
+            return f"{mt.group(1)} {mt.group(2)}({args})"
+        md = re.match(r"^(\w+)\s*\((.*)\)$", t)
+        if md:
+            if md.group(1) not in task_names:
+                data_used.add(md.group(1))
+            args = ", ".join(convert_expr(a, fm)
+                             for a in _split_args(md.group(2)))
+            return f"{md.group(1)}({args})"
+        return t
+
+    def conv_segment(seg: str) -> str:
+        seg = seg.strip()
+        q = _split_top(seg, "?")
+        if len(q) == 2:
+            guard = convert_expr(q[0].strip(), fm)
+            if not guard.startswith("("):
+                guard = f"({guard})"
+            branches = _split_top(q[1], ":")
+            s = f"{guard} ? {conv_target(branches[0])}"
+            if len(branches) == 2:
+                s += f" : {conv_target(branches[1])}"
+            return s
+        return conv_target(seg)
+
+    # flow prefix?
+    prefix = ""
+    mf = re.match(r"^(RW|READ|WRITE|CTL)\s+(\w+)\s*(.*)$", line)
+    if mf:
+        prefix = f"{mf.group(1)} {mf.group(2)} "
+        line = mf.group(3).strip()
+    if not line:
+        return prefix.rstrip()
+    # split arrow chain
+    segs = []
+    direction = None
+    start = 0
+    j = 0
+    depth = 0
+    while j < len(line):
+        ch = line[j]
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        if depth == 0 and line[j:j + 2] in ("<-", "->"):
+            if direction is not None:
+                segs.append((direction, line[start:j]))
+            direction = line[j:j + 2]
+            j += 2
+            start = j
+            continue
+        j += 1
+    segs.append((direction, line[start:]))
+    parts = []
+    for d, seg in segs:
+        conv = conv_segment(seg)
+        if re.search(r"\bNEW\b", conv):
+            conv += "  [type = DTT_DEFAULT]"
+        parts.append(f"{d} {conv}")
+    return prefix + (" ".join(parts)) + props
+
+
+def _split_args(s: str) -> list[str]:
+    return [a for a in _split_top(s, ",") if a.strip()]
+
+
+def _split_top(s: str, sep: str) -> list[str]:
+    out, depth, cur = [], 0, []
+    for ch in s:
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        if ch == sep and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return out
+
+
+def load_c_jdf(path: Any, bodies: dict[str, str] | None = None,
+               name: str | None = None,
+               field_map: dict[str, str] | None = None) -> JDF:
+    """Convert + parse a C-syntax ``.jdf`` file from disk."""
+    import pathlib
+    p = pathlib.Path(path)
+    return parse_jdf(convert_c_jdf(p.read_text(), bodies, field_map),
+                     name or p.stem)

@@ -858,6 +858,10 @@ def _task_graph(tp, infos):
                         tgt = index.get(
                             (d.target_class, tgt_tc.make_key(tgt_loc)))
                         if tgt is None:
+                            if tgt_tc.in_space is not None \
+                                    and not tgt_tc.in_space(tgt_loc):
+                                continue   # out-of-space edge: the
+                                # generated bounds check drops it
                             raise LoweringError(
                                 f"{cname}{info.tc.make_key(loc)} -> missing "
                                 f"successor {d.target_class}({tgt_loc})")

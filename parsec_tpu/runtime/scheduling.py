@@ -303,6 +303,9 @@ def release_deps(es: ExecutionStream, task: Task) -> None:
             return
         succ_tc = tp.task_class(dep.target_class)
         for succ_locals in dep.each_target(t.locals):
+            if succ_tc.in_space is not None \
+                    and not succ_tc.in_space(succ_locals):
+                continue   # out-of-space edge: the generated bounds check
             rank = _rank_of_task(ctx, succ_tc, succ_locals)
             if rank is not None and rank != ctx.my_rank:
                 remote = ctx.remote_dep_accumulate(remote, t, flow, dep,

@@ -207,6 +207,11 @@ class TaskClass:
         self.hash_struct = hash_struct    # KeyHashStruct or None
         self.startup_fn = startup_fn
         self.simcost = simcost
+        # execution-space membership test (locals -> bool), set by space-
+        # aware front-ends: out-of-space successor edges are DROPPED at
+        # release like the reference's generated bounds checks — C-syntax
+        # JDFs lean on this (`(k < NT) ? T PING(k+1)` at k = NT-1)
+        self.in_space: Callable[[dict], bool] | None = None
         self.repo = None                  # DataRepo, attached by the taskpool
         # counted mode: any ranged input dep means arrivals are *counted*
         # toward a per-task goal instead of OR-ed into a bitmask (the

@@ -1,0 +1,152 @@
+"""C-syntax JDF ingestion: the reference's OWN .jdf files, converted
+mechanically and executed (bodies supplied in Python — structure, spaces,
+guards, ranges, and arrows come straight from the reference text).
+"""
+
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+from parsec_tpu import ptg
+from parsec_tpu.data.datatype import TileType
+from parsec_tpu.data_dist.collection import DictCollection
+from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
+from parsec_tpu.ptg.jdf_c import convert_c_jdf, convert_expr, load_c_jdf
+from parsec_tpu.runtime import Context
+
+REF = pathlib.Path("/root/reference")
+needs_ref = pytest.mark.skipif(not REF.exists(),
+                               reason="reference tree not available")
+
+
+# ---------------------------------------------------------------------------
+# expression conversion
+# ---------------------------------------------------------------------------
+
+def test_convert_expr():
+    assert convert_expr("a && b || !c") == "a and b or not c"
+    assert convert_expr("x != 1 && !y") == "x != 1 and not y"
+    assert convert_expr("descA->lmt - 1") == "descA.mt - 1"
+    assert convert_expr("descA->super.myrank") == "descA.myrank"
+    assert convert_expr("l/2 + k%3") == "l//2 + k%3"
+    assert convert_expr("(1<<n)-1") == "(1<<n)-1"
+
+
+# ---------------------------------------------------------------------------
+# the reference's own files
+# ---------------------------------------------------------------------------
+
+@needs_ref
+def test_ex02_chain_runs():
+    """examples/Ex02_Chain.jdf: NEW-rooted chain of NB+1 tasks; the C
+    body (*A += 1) becomes a Python body; taskdist is declared only in
+    the C epilogue and gets synthesized as a data global."""
+    jdf = load_c_jdf(REF / "examples" / "Ex02_Chain.jdf", bodies={
+        "Task": "A[...] = 0 if k == 0 else A[...] + 1",
+    })
+    NB = 9
+    taskdist = DictCollection("taskdist",
+                              dtt=TileType((1,), np.int32),
+                              init_fn=lambda *k: np.zeros(1, np.int32))
+    tp = jdf.build(taskdist=taskdist, NB=NB,
+                   DTT_DEFAULT=TileType((1,), np.int32))
+    done = {}
+
+    # capture the final chain value through an extra probe body wrap:
+    # simplest is to re-run with a recording body
+    jdf2 = load_c_jdf(REF / "examples" / "Ex02_Chain.jdf", bodies={
+        "Task": "A[...] = 0 if k == 0 else A[...] + 1\n"
+                "out[k] = int(A[0])",
+    })
+    out = {}
+    tp2 = jdf2.build(taskdist=taskdist, NB=NB,
+                     DTT_DEFAULT=TileType((1,), np.int32))
+    tp2._builder.globals["out"] = out
+    jdf2.globals_decl["out"] = {}      # visible to bodies via globals
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp2)
+        ctx.wait(timeout=60)
+    assert out[NB] == NB               # 0 at k=0, +1 per link
+
+
+@needs_ref
+def test_rtt_pingpong_runs():
+    """tests/apps/pingpong/rtt.jdf VERBATIM: the `(k < NT) ? T PING(k+1)`
+    arrow leaves the execution space at k = NT-1 and relies on the
+    generated bounds check — the runtime's space-membership drop."""
+    jdf = load_c_jdf(REF / "tests" / "apps" / "pingpong" / "rtt.jdf",
+                     bodies={"PING": "T[...] += 1.0"})
+    NT = 12
+    A = VectorTwoDimCyclic("A", lm=1, mb=1,
+                           init_fn=lambda m, s: np.zeros(s, np.float32))
+    tp = jdf.build(A=A, NT=NT, WS=1)
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+    assert float(np.asarray(A.data_of(0).newest_copy().value)[0]) == NT
+
+
+@needs_ref
+def test_ex05_broadcast_runs():
+    """examples/Ex05_Broadcast.jdf verbatim: range arrow fan-out, the
+    hidden default NB=(6), derived local loc."""
+    jdf = load_c_jdf(REF / "examples" / "Ex05_Broadcast.jdf", bodies={
+        "TaskBcast": "A[...] = k",
+        "TaskRecv": "assert int(A[0]) == k, (k, n)",
+    })
+    nodes = 3
+    md = VectorTwoDimCyclic("mydata", lm=nodes + 7, mb=1, dtype=np.int32,
+                            init_fn=lambda m, s: np.zeros(s, np.int32))
+    tp = jdf.build(mydata=md, nodes=nodes, rank=0)
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)      # Recv assertions are the check
+
+
+@needs_ref
+def test_ex07_raw_ctl_runs():
+    """examples/Ex07_RAW_CTL.jdf verbatim: the counted CTL fan-in
+    (`<- ctl TaskRecv(k, 0 .. NB .. 2)`) orders updates after reads."""
+    jdf = load_c_jdf(REF / "examples" / "Ex07_RAW_CTL.jdf", bodies={
+        "TaskBcast": "A[...] = k + 1",
+        "TaskRecv": "assert int(A[0]) == k + 1, (k, n)",
+        "TaskUpdate": "A[...] = -k - 1",
+    })
+    nodes = 4
+    md = VectorTwoDimCyclic("mydata", lm=nodes + 7, mb=1, dtype=np.int32,
+                            init_fn=lambda m, s: np.zeros(s, np.int32))
+    tp = jdf.build(mydata=md, nodes=nodes, rank=0)
+    with Context(nb_cores=2) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+    for k in range(nodes):
+        assert int(np.asarray(md.data_of(k).newest_copy().value)[0]) \
+            == -k - 1
+
+
+@needs_ref
+def test_a2a_structure_parses_and_single_round_runs():
+    """tests/apps/all2all/a2a.jdf: five classes, cross-product SEND/RECV
+    wiring, a ranged CTL fan-in — ingested structure-only (pass bodies)
+    and drained at NR=1 (the full NT x NT exchange plus the counted
+    FANIN join).
+
+    KNOWN LIMIT (documented in jdf_c): the reference's READER_B/FANOUT
+    round chains declare `<- A FANOUT(r-1, t)` with NO reciprocal output
+    arrow — jdf2c's dataflow analysis forwards read-chains to their data
+    origin, which this mechanical converter does not replicate, so
+    multi-round (NR > 1) needs those arrows made explicit (as
+    models/irregular.all2all_ptg does)."""
+    jdf = load_c_jdf(REF / "tests" / "apps" / "all2all" / "a2a.jdf")
+    assert set(jdf.tasks) == {"READER_B", "FANOUT", "SEND", "RECV",
+                              "FANIN"}
+    NR, NT = 1, 3
+    mk2 = lambda nm: DictCollection(
+        nm, dtt=TileType((1,), np.float32),
+        init_fn=lambda *k: np.zeros(1, np.float32))
+    tp = jdf.build(descA=mk2("descA"), descB=mk2("descB"), NR=NR, NT=NT)
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
