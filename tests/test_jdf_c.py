@@ -3,17 +3,15 @@ mechanically and executed (bodies supplied in Python — structure, spaces,
 guards, ranges, and arrows come straight from the reference text).
 """
 
-import os
 import pathlib
 
 import numpy as np
 import pytest
 
-from parsec_tpu import ptg
 from parsec_tpu.data.datatype import TileType
 from parsec_tpu.data_dist.collection import DictCollection
 from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
-from parsec_tpu.ptg.jdf_c import convert_c_jdf, convert_expr, load_c_jdf
+from parsec_tpu.ptg.jdf_c import convert_expr, load_c_jdf
 from parsec_tpu.runtime import Context
 
 REF = pathlib.Path("/root/reference")

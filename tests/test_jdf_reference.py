@@ -23,7 +23,7 @@ from parsec_tpu import ptg
 from parsec_tpu.comm import run_multirank
 from parsec_tpu.data_dist.matrix import TwoDimBlockCyclic, VectorTwoDimCyclic
 from parsec_tpu.models.stencil import stencil_reference
-from parsec_tpu.runtime import Context, LocalTermDet, UserTriggerTermDet
+from parsec_tpu.runtime import Context, UserTriggerTermDet
 
 JDF_DIR = pathlib.Path(__file__).resolve().parent.parent / "examples" / "jdf"
 

@@ -6,14 +6,11 @@ round-trip (jdf_unparse role).
 import time
 
 import numpy as np
-import pytest
 
 from parsec_tpu import ptg
 from parsec_tpu.core.mca import repository
-from parsec_tpu.core.params import params
 from parsec_tpu.core.topology import (core_of_stream, distance, llc_group_of,
                                       llc_groups)
-from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
 from parsec_tpu.prof.counters import sde
 from parsec_tpu.runtime import Context
 

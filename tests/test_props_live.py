@@ -9,11 +9,9 @@ import threading
 import time
 
 import numpy as np
-import pytest
 
 from parsec_tpu import ptg
 import parsec_tpu.runtime.dagrun  # noqa: F401  (registers runtime_dag_compile)
-from parsec_tpu.core.params import params
 from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
 from parsec_tpu.prof.counters import properties, read_live_snapshot, sde
 from parsec_tpu.runtime import Context

@@ -8,7 +8,6 @@ the sub-writes exactly as if the body had produced them.
 """
 
 import numpy as np
-import pytest
 
 from parsec_tpu import ptg
 from parsec_tpu.comm import run_multirank
