@@ -639,9 +639,17 @@ def init_tpu_devices() -> list[TPUDevice]:
     _initialized = True
     if not _params.register("device_tpu_enabled", True).value:
         return []
+    # PARSEC_MCA_device_tpu_allow_cpu=1: register host CPU devices as
+    # accelerators so the full dynamic device path (stage-in, LRU,
+    # batched dispatch) is exercisable without a chip — used by the
+    # bench smoke mode and CI (the reference's gating of GPU tests on
+    # real hardware is the inverse policy; here the device module's
+    # logic is platform-independent XLA, so CPU coverage is real)
+    allow_cpu = _params.register("device_tpu_allow_cpu", False).value
     try:
         import jax
-        devs = [d for d in jax.devices() if d.platform != "cpu"]
+        devs = [d for d in jax.devices()
+                if allow_cpu or d.platform != "cpu"]
     except Exception:
         devs = []
     out = []
