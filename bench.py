@@ -196,12 +196,15 @@ def bench_dynamic_gemm_gflops(n: int = 8192, nb: int = 1024) -> dict:
     tm0 = dev.t_manager
     ctx = Context(nb_cores=0)
     t0 = time.perf_counter()
-    ctx.add_taskpool(tp)
-    ctx.wait(timeout=120)
-    t_drained = time.perf_counter() - t0
-    dev.sync()
-    t = time.perf_counter() - t0
-    ctx.fini()
+    try:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+        t_drained = time.perf_counter() - t0
+        dev.sync()
+        t = time.perf_counter() - t0
+    finally:
+        ctx.fini()      # a timed-out drain must not leak the Context +
+        #                 tile set into every later stage on this device
     calls = dev.xla_calls - calls0
     h2d = dev.bytes_in - bin0
     stage_s = dev.t_stage_in - ts0
@@ -259,11 +262,13 @@ def bench_dynamic_cholesky_gflops(n: int = 8192, nb: int = 1024) -> dict:
     tp = tiled_cholesky_ptg(A, devices="tpu")
     ctx = Context(nb_cores=0)
     t0 = time.perf_counter()
-    ctx.add_taskpool(tp)
-    ctx.wait(timeout=120)
-    dev.sync()
-    t = time.perf_counter() - t0
-    ctx.fini()
+    try:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+        dev.sync()
+        t = time.perf_counter() - t0
+    finally:
+        ctx.fini()
     # correctness spot check: || L[0,0] - chol(A)[0,0] tile || small
     got = np.asarray(A.data_of(0, 0).newest_copy().value)
     expect = np.linalg.cholesky(a[:nb, :nb].astype(np.float64))
@@ -417,21 +422,24 @@ def bench_dtd_gemm_tpu(n: int = 8192, nb: int = 1024) -> dict:
 
     ctx = Context(nb_cores=0)
     tp = DTDTaskpool()
-    ctx.add_taskpool(tp)
-    t0 = time.perf_counter()
-    for m in range(NT):
-        for n_ in range(NT):
-            for k in range(NT):
-                tp.insert_task(gemm, (A[m][k], INPUT), (B[k][n_], INPUT),
-                               (C[m][n_], INOUT), tpu_kernel="gemm")
-    tp.wait()
-    dev.sync()
-    t = time.perf_counter() - t0
-    # spot-check OUTSIDE the timed section: read the final (device) version
-    # of one C tile — a D2H pull, which through the axon relay times the
-    # tunnel (~70ms RTT/tile), not the framework (BASELINE.md env note)
-    got = np.asarray(tp.tile_of_array(C[0][0]).data.newest_copy().value)
-    ctx.fini()
+    try:
+        ctx.add_taskpool(tp)
+        t0 = time.perf_counter()
+        for m in range(NT):
+            for n_ in range(NT):
+                for k in range(NT):
+                    tp.insert_task(gemm, (A[m][k], INPUT),
+                                   (B[k][n_], INPUT),
+                                   (C[m][n_], INOUT), tpu_kernel="gemm")
+        tp.wait()
+        dev.sync()
+        t = time.perf_counter() - t0
+        # spot-check OUTSIDE the timed section: read the final (device)
+        # version of one C tile — a D2H pull, which through the axon relay
+        # times the tunnel (~70ms RTT/tile), not the framework
+        got = np.asarray(tp.tile_of_array(C[0][0]).data.newest_copy().value)
+    finally:
+        ctx.fini()
     want = np.zeros((nb, nb), np.float32)
     for k in range(NT):
         want += A[0][k] @ B[k][0]
@@ -525,9 +533,11 @@ def _staged(name, fn, *a, timeout=120.0, retries=1, **kw):
         if th.is_alive():
             print(f"[bench] {name}: TIMEOUT after {wall:.1f}s — stage "
                   f"thread abandoned", file=sys.stderr, flush=True)
+            prior = list(_abandoned)
             _abandoned.append(name)
             return {"gflops": 0.0,
-                    "error": f"stage timeout after {timeout:.0f}s"}
+                    "error": f"stage timeout after {timeout:.0f}s",
+                    **({"tainted_by": prior} if prior else {})}
         if "err" in box:
             e = box["err"]
             print(f"[bench] {name}: attempt {attempt + 1} failed "

@@ -192,6 +192,9 @@ class JDF:
         self.globals_decl: dict[str, dict] = {}   # name -> props
         self.tasks: dict[str, _TaskDecl] = {}
         self.options: dict[str, str] = {}         # %option lines
+        # rewrite notes from jdf_c.resolve_read_chains (empty when the
+        # pass hasn't run or found nothing to forward)
+        self.read_chain_notes: list[str] = []
 
     # -- build ---------------------------------------------------------------
     def build(self, **bindings: Any) -> PTGTaskpool:

@@ -38,13 +38,16 @@ Out-of-space successor arrows (``(k < NT) ? T PING(k+1)`` at
 ``k = NT-1``, ``rtt.jdf:16``) rely on the generated bounds check; the
 runtime's execution-space membership drop covers them.
 
-KNOWN LIMIT: jdf2c performs symbolic dataflow analysis that forwards
-*read chains* to their data origin — an input arrow
-``<- A FANOUT(r-1, t)`` whose predecessor flow is READ and declares no
-reciprocal output arrow (``a2a.jdf:58``) still resolves.  This
-converter is mechanical, not symbolic: such files need the reciprocal
-arrows made explicit (one line each) or the app rebuilt with them (as
-``models/irregular.all2all_ptg`` does).
+Read-chain forwarding: jdf2c's symbolic dataflow analysis forwards an
+input arrow that names a predecessor READ flow with *no reciprocal
+output arrow* (``<- A FANOUT(r-1, t)``, ``a2a.jdf:58``) to that flow's
+data origin.  :func:`resolve_read_chains` does the mechanical version of
+the same fixpoint after parsing: a READ flow whose single input is
+``(base) ? D(args) : F SELF(shifted)`` with ``args`` invariant under the
+shift resolves to ``D(args)``; any input referencing a reciprocal-less
+READ flow is rewritten to that resolved origin.  ``load_c_jdf`` applies
+it by default, so the reference's ``a2a.jdf`` ingests and drains all
+rounds verbatim.
 """
 
 from __future__ import annotations
@@ -369,11 +372,137 @@ def _split_top(s: str, sep: str) -> list[str]:
     return out
 
 
+def _subst_ids(expr: str, mapping: dict[str, str]) -> str:
+    """Simultaneous identifier substitution in an expression string; a
+    replacement that is itself a compound expression is parenthesized."""
+    if not expr:
+        return expr
+
+    def rep(m: re.Match) -> str:
+        w = m.group(0)
+        if w not in mapping:
+            return w
+        v = mapping[w].strip()
+        return v if re.fullmatch(r"\w+", v) else f"({v})"
+
+    return re.sub(r"\b\w+\b", rep, expr)
+
+
+def _norm_expr(s: str | None) -> str:
+    return re.sub(r"\s+", "", s or "")
+
+
+def resolve_read_chains(jdf: JDF) -> list[str]:
+    """jdf2c's read-chain forwarding, as a post-parse fixpoint
+    (``jdf2c.c`` resolves such chains during its symbolic dataflow pass;
+    this runtime activates inputs from the producer side, so an input
+    arrow with no reciprocal output would never fire).
+
+    For every input arrow whose source is a task flow (S, G) such that
+    S.G declares **no output arrow back to the consuming flow**, resolve
+    S.G's data *origin* and rewrite the input to read that data
+    directly.  An origin exists when S.G is a READ flow whose single
+    input collapses — base-case data with arguments invariant under the
+    self-chain's index shift (``(r == 0) ? descA(t, 0) : A FANOUT(r-1,
+    t)`` resolves to ``descA(t, 0)`` for every r).  Returns a list of
+    human-readable rewrite notes (tests assert on them)."""
+    from .dsl import READ
+
+    # reciprocity index: (src task, src flow) -> {(dst task, dst flow)}
+    recip: set[tuple] = set()
+    for t in jdf.tasks.values():
+        for fd in t.flows:
+            for ar in fd.arrows:
+                if ar.direction != "out":
+                    continue
+                for tgt in (ar.then_tgt, ar.else_tgt):
+                    if tgt and tgt[0] == "task":
+                        recip.add((t.name, fd.name, tgt[1], tgt[2]))
+
+    def flow_of(tname: str, fname: str):
+        t = jdf.tasks.get(tname)
+        if t is None:
+            return None, None
+        return t, next((f for f in t.flows if f.name == fname), None)
+
+    def origin(tname: str, fname: str, depth: int = 0):
+        """Data origin of READ flow ``tname.fname`` in its own params:
+        ``("data", coll, None, args)`` or None."""
+        if depth > 8:
+            return None
+        t, fd = flow_of(tname, fname)
+        if fd is None or fd.access is not READ:
+            return None
+        ins = [ar for ar in fd.arrows if ar.direction == "in"]
+        if len(ins) != 1:
+            return None
+        ar = ins[0]
+        then, els = ar.then_tgt, ar.else_tgt
+        if els is None:
+            return then if then[0] == "data" else None
+        if then[0] != "data":
+            return None
+        if els[0] == "data":
+            if els[1] == then[1] and _norm_expr(els[3]) == _norm_expr(
+                    then[3]):
+                return then
+            return None
+        if els[0] != "task":
+            return None
+        mapping = dict(zip(jdf.tasks[els[1]].params,
+                           _split_args(els[3] or "")))
+        if (els[1], els[2]) == (tname, fname):
+            # self chain: the base data args must be a fixpoint of the
+            # index shift (independent of the recurrence variable)
+            if _norm_expr(_subst_ids(then[3], mapping)) == _norm_expr(
+                    then[3]):
+                return then
+            return None
+        o = origin(els[1], els[2], depth + 1)
+        if o is None:
+            return None
+        resolved_args = _subst_ids(o[3], mapping)
+        if o[1] == then[1] and _norm_expr(resolved_args) == _norm_expr(
+                then[3]):
+            return then
+        return None
+
+    notes: list[str] = []
+    for t in jdf.tasks.values():
+        for fd in t.flows:
+            for ar in fd.arrows:
+                if ar.direction != "in":
+                    continue
+                for attr in ("then_tgt", "else_tgt"):
+                    tgt = getattr(ar, attr)
+                    if not tgt or tgt[0] != "task":
+                        continue
+                    src_t, src_f = tgt[1], tgt[2]
+                    if (src_t, src_f, t.name, fd.name) in recip:
+                        continue           # producer forwards; no rewrite
+                    o = origin(src_t, src_f)
+                    if o is None:
+                        continue
+                    src_task = jdf.tasks[src_t]
+                    mapping = dict(zip(src_task.params,
+                                       _split_args(tgt[3] or "")))
+                    new_args = _subst_ids(o[3], mapping)
+                    setattr(ar, attr, ("data", o[1], None, new_args))
+                    notes.append(
+                        f"{t.name}.{fd.name} <- {src_t}.{src_f} resolved "
+                        f"to {o[1]}({new_args})")
+    return notes
+
+
 def load_c_jdf(path: Any, bodies: dict[str, str] | None = None,
                name: str | None = None,
-               field_map: dict[str, str] | None = None) -> JDF:
+               field_map: dict[str, str] | None = None,
+               forward_read_chains: bool = True) -> JDF:
     """Convert + parse a C-syntax ``.jdf`` file from disk."""
     import pathlib
     p = pathlib.Path(path)
-    return parse_jdf(convert_c_jdf(p.read_text(), bodies, field_map),
-                     name or p.stem)
+    jdf = parse_jdf(convert_c_jdf(p.read_text(), bodies, field_map),
+                    name or p.stem)
+    if forward_read_chains:
+        jdf.read_chain_notes = resolve_read_chains(jdf)
+    return jdf

@@ -111,8 +111,16 @@ def test_hung_stage_is_abandoned_not_fatal():
     """A stage that never returns must be timed out, recorded as degraded,
     and must not stop later stages from reporting."""
     import bench
-    res = bench._staged("hang", lambda: time.sleep(60), timeout=0.5)
-    assert "error" in res and "timeout" in res["error"]
+    before = list(bench._abandoned)
+    try:
+        res = bench._staged("hang", lambda: time.sleep(60), timeout=0.5)
+        assert "error" in res and "timeout" in res["error"]
+        assert bench._abandoned == before + ["hang"]
+        # a later successful stage carries the taint marker
+        ok = bench._staged("after", lambda: {"gflops": 1.0}, timeout=5.0)
+        assert ok["tainted_by"] == before + ["hang"]
+    finally:
+        bench._abandoned[:] = before
 
 
 def test_failing_stage_degrades_with_reason():
