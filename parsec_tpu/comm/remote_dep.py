@@ -42,6 +42,7 @@ import numpy as np
 
 from ..core.params import params as _params
 from ..data.data import data_create
+from ..data.datatype import wire_slice_key
 from ..runtime.scheduling import (ExecutionStream, _find_input_dep,
                                   apply_writeback_to_home, schedule_tasks)
 from ..runtime.task import Task
@@ -58,6 +59,9 @@ _params.register("comm_coalesce", True,
                  "stage outgoing activations and flush one "
                  "priority-ordered AM per peer per progress "
                  "(remote_dep_mpi.c:1066-1194 aggregation)")
+_params.register("comm_wire_datatypes", True,
+                 "honor partial-tile wire datatypes ([type_remote/"
+                 "displ_remote]) on remote edges; off ships full tiles")
 _params.register("comm_bcast_tree", "binomial",
                  "multi-peer activation propagation: binomial|chain|star")
 
@@ -70,6 +74,18 @@ def _wire_value(value: Any) -> Any:
     if is_device_array(value):
         return value
     return np.asarray(value)
+
+
+def _slice_view(value: Any, view_key: tuple) -> Any:
+    """Cut the wire view out of a tile (host or device array).  The copy
+    is deliberate for host arrays: the wire must not alias the live tile
+    a local successor may be mutating."""
+    sl = tuple(slice(*s) if isinstance(s, (tuple, list)) else s
+               for s in view_key)
+    out = value[sl]
+    if isinstance(out, np.ndarray):
+        out = np.ascontiguousarray(out)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -101,13 +117,19 @@ def tree_children(kind: str, position: int, n: int) -> list[int]:
 # ---------------------------------------------------------------------------
 
 class _RemoteOutput:
-    __slots__ = ("flow_index", "copy", "ranks", "writeback_ranks")
+    __slots__ = ("flow_index", "copy", "ranks", "writeback_ranks", "views")
 
     def __init__(self, flow_index: int) -> None:
         self.flow_index = flow_index
         self.copy = None              # producing DataCopy (None for CTL)
         self.ranks: set[int] = set()  # ranks with consumer successors
         self.writeback_ranks: set[int] = set()  # remote home-tile writebacks
+        # rank -> wire view key (slice triples) | None (full tile): the
+        # partial-tile wire datatypes of the edges that reached that rank
+        # ([type_remote/displ_remote]); a rank touched by several edges
+        # with DIFFERENT views degrades to the full tile (the superset is
+        # always correct; the reference picks one dep's datatype per rank)
+        self.views: dict[int, tuple | None] = {}
 
 
 class RemoteDeps:
@@ -165,6 +187,11 @@ class RemoteDepEngine:
         self._inflight: dict[int, Any] = {}
         self._iflock = threading.Lock()
         self.dup_acks = 0      # duplicate/unknown acks tolerated (faults)
+        # activation payload bytes staged by THIS rank as a bcast root
+        # (post wire-view slicing; counted once per receiving peer) — the
+        # counter that proves partial-tile wire datatypes cut halo
+        # traffic (~NB/R for the stencil's LR edges)
+        self.payload_bytes_staged = 0
         # activations/DTD messages whose taskpool comm-id is not registered
         # yet (cf. DEP_NEW_TASKPOOL delays, remote_dep_mpi.c); guarded by a
         # lock: appended from worker progress, replayed from the enqueuing
@@ -293,9 +320,17 @@ class RemoteDepEngine:
         if not flow.is_ctl:
             out.copy = task.data[flow.flow_index]
         if succ_tc is None:
+            # home-tile writeback must carry the whole tile
             out.writeback_ranks.add(rank)
+            out.views[rank] = None
         else:
             out.ranks.add(rank)
+            vk = (wire_slice_key(dep.wire_slices(task.locals))
+                  if _params.get("comm_wire_datatypes") else None)
+            if rank in out.views and out.views[rank] != vk:
+                out.views[rank] = None     # conflicting views: full tile
+            else:
+                out.views.setdefault(rank, vk)
         return remote
 
     def activate(self, es: Any, task: Task, remote: RemoteDeps) -> None:
@@ -305,30 +340,48 @@ class RemoteDepEngine:
         one propagation tree; odd one-off masks fall back to direct sends.
         """
         tp = task.taskpool
+        # group peers by (flow set + per-flow wire view): ranks receiving
+        # identical bytes share one propagation tree; a partial-tile view
+        # ([type_remote]) forms its own group so the sliced payload is cut
+        # once and broadcast, never re-sliced per peer
         by_mask: dict[tuple, list[int]] = {}
         all_ranks: dict[int, set[int]] = {}
         for fi, out in remote.outputs.items():
             for r in out.ranks | out.writeback_ranks:
                 all_ranks.setdefault(r, set()).add(fi)
         for r, flows in all_ranks.items():
-            by_mask.setdefault(tuple(sorted(flows)), []).append(r)
+            key = tuple((fi, remote.outputs[fi].views.get(r))
+                        for fi in sorted(flows))
+            by_mask.setdefault(key, []).append(r)
 
         for flows, ranks in by_mask.items():
             ranks.sort()
             outputs = []
-            for fi in flows:
+            for fi, view in flows:
                 out = remote.outputs[fi]
                 desc = {"flow_index": fi,
                         "writeback": bool(out.writeback_ranks)}
                 if out.copy is not None:
                     value = _wire_value(out.copy.value)
+                    owned = False
+                    if view is not None:
+                        # partial-tile wire datatype: ship only the
+                        # declared sub-block (the LR ghost columns, not
+                        # the whole tile); the consumer receives it as a
+                        # standalone region buffer
+                        value = _slice_view(value, view)   # owned copy
+                        desc["wire_view"] = view
+                        owned = True
+                    self.payload_bytes_staged += int(
+                        getattr(value, "nbytes", 0)) * len(ranks)
                     desc["version"] = out.copy.version
                     if value.nbytes <= _params.get("comm_short_limit"):
                         # receiver must own its bytes even in-process
-                        # (immutable device arrays ride as-is)
+                        # (immutable device arrays ride as-is; a sliced
+                        # view was already cut to an owned buffer)
                         desc["inline"] = (value.copy()
                                           if isinstance(value, np.ndarray)
-                                          else value)
+                                          and not owned else value)
                     else:
                         all_ranks = [self.my_rank] + ranks
                         child_ranks = [

@@ -38,6 +38,55 @@ class TileType:
         return self.shape == other.shape and np.dtype(self.dtype) == np.dtype(other.dtype)
 
 
+@dataclass(frozen=True)
+class WireRegion:
+    """A partial-tile *wire datatype* for remote edges — the role of the
+    reference's ``parsec_add2arena_rect`` arena types selected per-dep by
+    ``[type_remote = LR, displ_remote = ...]``
+    (``tests/apps/stencil/stencil_1D.jdf:83-92``; MPI derived datatypes
+    underneath, ``parsec/datatype/datatype_mpi.c``).
+
+    Semantics: an edge tagged with a wire region ships ``rows x cols``
+    elements of the producing tile instead of the full tile; the remote
+    consumer receives that sub-block as a standalone buffer (exactly the
+    reference contract — its remote receive buffer IS the LR region, and
+    the body's displacement logic copes with full-local vs. region-remote,
+    ``CORE_copydata_stencil_1D``).  Local edges are untouched: same-rank
+    consumers share the full tile copy.
+
+    The displacement follows the reference's convention: a BYTE offset
+    into the tile in its column-major storage order, so ingested
+    ``displ_remote`` expressions (``sizeof_datatype*mb*R``) work verbatim.
+    For this repo's row-major ``(mb, nb)`` numpy/JAX tiles, a column-major
+    byte offset of ``itemsize*mb*c0`` selects columns ``c0:c0+cols`` —
+    i.e. ``tile[:, c0:c0+cols]``."""
+
+    rows: int
+    cols: int
+    itemsize: int = 4
+
+    def slices(self, displ_bytes: int = 0) -> tuple:
+        elems = displ_bytes // self.itemsize
+        if elems % self.rows:
+            raise ValueError(
+                f"displ_remote {displ_bytes}B is not column-aligned for a "
+                f"{self.rows}-row region (itemsize {self.itemsize})")
+        c0 = elems // self.rows
+        return (slice(None), slice(c0, c0 + self.cols))
+
+    @property
+    def nbytes(self) -> int:
+        return self.rows * self.cols * self.itemsize
+
+
+def wire_slice_key(slices: tuple | None) -> tuple | None:
+    """Hashable identity of a wire view (grouping + message metadata)."""
+    if slices is None:
+        return None
+    return tuple((s.start, s.stop, s.step) if isinstance(s, slice) else s
+                 for s in slices)
+
+
 # layout tag -> (to_canonical, from_canonical); jittable array->array fns.
 _layout_converters: dict[str, tuple] = {
     "row_major": (lambda x: x, lambda x: x),

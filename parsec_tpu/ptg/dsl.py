@@ -117,8 +117,15 @@ class FlowBuilder:
         return self
 
     def output(self, succ: tuple | None = None, data: tuple | None = None,
-               guard: Callable | None = None, dtt: Any = None) -> "FlowBuilder":
-        self._deps_out.append(self._tcb._mk_dep(succ, data, guard, dtt))
+               guard: Callable | None = None, dtt: Any = None,
+               wire: Any = None) -> "FlowBuilder":
+        """``wire=`` tags the edge with a partial-tile wire datatype
+        (JDF ``[type_remote = .., displ_remote = ..]``): a tuple of
+        slices or ``wire_fn(g, l) -> slices`` selecting the sub-view a
+        REMOTE consumer receives; same-rank edges always share the full
+        tile (see data/datatype.py WireRegion)."""
+        self._deps_out.append(self._tcb._mk_dep(succ, data, guard, dtt,
+                                                wire=wire))
         return self
 
     def _build(self) -> Flow:
@@ -265,11 +272,14 @@ class TaskClassBuilder:
     def _mk_dep(self, ref: tuple | None, data: tuple | None,
                 guard: Callable | None, dtt: Any,
                 new: bool = False, null: bool = False,
-                ranged: bool = False) -> Dep:
+                ranged: bool = False, wire: Any = None) -> Dep:
         g_ns = self._ptg._g_ns
         gfn = None
         if guard is not None:
             gfn = lambda locals_: guard(g_ns(), _ns(locals_))
+        wfn = wire
+        if callable(wire):
+            wfn = lambda locals_: wire(g_ns(), _ns(locals_))
         if new or null:
             # NEW: all targets None — resolve_data_inputs leaves the slot
             # empty and prepare_input allocates scratch of the flow type;
@@ -280,7 +290,7 @@ class TaskClassBuilder:
             tparams = lambda locals_: params_fn(g_ns(), _ns(locals_))
             return Dep(guard=gfn, target_class=cls_name,
                        target_flow=flow_name, target_params=tparams, dtt=dtt,
-                       ranged=ranged)
+                       ranged=ranged, wire=wfn)
         if data is not None:
             collection, key_fn = data
             dc_get = self._ptg._dc_getter(collection)
@@ -291,7 +301,7 @@ class TaskClassBuilder:
                     key = (key,)
                 return dc_get(), key
 
-            return Dep(guard=gfn, data_ref=data_ref, dtt=dtt)
+            return Dep(guard=gfn, data_ref=data_ref, dtt=dtt, wire=wfn)
         # pure CTL arrow with neither: invalid
         raise ValueError("dep needs a task ref or a data ref")
 

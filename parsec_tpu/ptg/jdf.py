@@ -350,6 +350,29 @@ class JDF:
                     f"line {ar.line}: [type={tname}] must name a TileType "
                     f"global or prologue binding (got "
                     f"{type(dtt).__name__})")
+        # [type_remote = NAME, displ_remote = expr]: partial-tile wire
+        # datatype (stencil_1D.jdf:83-92 role).  NAME resolves to a
+        # WireRegion (prologue/build binding); displ_remote is a BYTE
+        # offset expression evaluated per task instance; the edge ships
+        # region.slices(displ) to remote peers instead of the full tile.
+        wire = None
+        wname = ar.props.get("type_remote")
+        if wname is not None:
+            from ..data.datatype import WireRegion
+            region = (typeenv or {}).get(wname)
+            if isinstance(region, WireRegion):
+                displ_fn = (expr(str(ar.props["displ_remote"]))
+                            if "displ_remote" in ar.props else None)
+
+                def wire(g, l, _r=region, _d=displ_fn):
+                    return _r.slices(int(_d(g, l)) if _d else 0)
+            elif region is not None:
+                raise JDFError(
+                    f"line {ar.line}: [type_remote={wname}] must name a "
+                    f"WireRegion global or prologue binding (got "
+                    f"{type(region).__name__})")
+            # unbound name (e.g. FULL, or an arena the app never defines):
+            # full-tile wire — the reference's default datatype behavior
         for tgt, gfn in ((ar.then_tgt, guard),
                         (ar.else_tgt, neg if ar.else_tgt else None)):
             if tgt is None:
@@ -427,9 +450,14 @@ class JDF:
                             f"line {ar.line}: range input on data flow "
                             f"{fd.name} — N producers for one datum is "
                             f"nondeterministic; range fan-in is CTL-only")
+                    # [type_remote] on an INPUT arrow is accepted for
+                    # reference fidelity but carries no runtime action:
+                    # the wire view is a producer-side (output dep)
+                    # decision; the consumer recognizes a region payload
+                    # by shape (the body's local-vs-remote branch)
                     fb.input(pred=ref, guard=gfn, dtt=dtt, ranged=any_rng)
                 else:
-                    fb.output(succ=ref, guard=gfn, dtt=dtt)
+                    fb.output(succ=ref, guard=gfn, dtt=dtt, wire=wire)
             else:   # data
                 if fd.access == CTL:
                     raise JDFError(
@@ -563,18 +591,46 @@ _RE_TARGET_TASK = re.compile(r"^(\w+)\s+(\w+)\s*\((.*)\)$")
 _RE_TARGET_DATA = re.compile(r"^(\w+)\s*\((.*)\)$")
 
 
-_RE_PROP = re.compile(r"(\w+)\s*=\s*([\w.\-]+)|(\w+)")
+_RE_PROP_KEY = re.compile(r"(\w+)\s*(=)?\s*")
+_RE_PROP_BARE = re.compile(r"[\w.\-*%/+]+")
 
 
 def _parse_props(s: str | None) -> dict:
-    out = {}
+    """``key = value`` pairs and bare flags.  Values are either a
+    balanced parenthesized expression at ARBITRARY depth (displ_remote
+    formulas — a regex depth cap here once misparsed a deep expression
+    as a flag and shipped the wrong ghost columns) or a spaceless token
+    run."""
+    out: dict = {}
     if not s:
         return out
-    for m in _RE_PROP.finditer(s):
-        if m.group(1):
-            out[m.group(1)] = m.group(2)
+    i, n = 0, len(s)
+    while i < n:
+        m = _RE_PROP_KEY.match(s, i)
+        if m is None:
+            i += 1
+            continue
+        key, has_eq = m.group(1), m.group(2)
+        i = m.end()
+        if not has_eq:
+            out[key] = True
+            continue
+        if i < n and s[i] == "(":
+            depth, j = 0, i
+            while j < n:
+                if s[j] == "(":
+                    depth += 1
+                elif s[j] == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                j += 1
+            out[key] = s[i:j + 1]
+            i = j + 1
         else:
-            out[m.group(3)] = True
+            mv = _RE_PROP_BARE.match(s, i)
+            out[key] = mv.group(0) if mv else True
+            i = mv.end() if mv else i
     return out
 
 

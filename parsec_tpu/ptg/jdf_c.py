@@ -97,7 +97,46 @@ def _convert_inline(s: str, fm) -> str:
 
 _RE_EXTERN = re.compile(r'extern\s+"C"\s*%\{.*?%\}', re.S)
 _RE_GLOBAL_C = re.compile(r"^(\w+)\s*\[(.*)\]\s*$")
-_RE_PROP_C = re.compile(r'(\w+)\s*=\s*(?:"([^"]*)"|(\S+))')
+_RE_PROP_KEY_C = re.compile(r"(\w+)\s*=\s*")
+
+
+def _scan_props_c(s: str) -> list[tuple[str, str]]:
+    """``key = value`` pairs from a C-syntax property block.  Values are
+    quoted strings, balanced parenthesized expressions at arbitrary
+    depth (converted ``%{ return ...; %}`` fragments), or bare tokens."""
+    out: list[tuple[str, str]] = []
+    i, n = 0, len(s)
+    while i < n:
+        m = _RE_PROP_KEY_C.match(s, i)
+        if m is None:
+            i += 1
+            continue
+        key = m.group(1)
+        i = m.end()
+        if i < n and s[i] == '"':
+            j = s.find('"', i + 1)
+            j = n - 1 if j < 0 else j
+            out.append((key, s[i + 1:j]))
+            i = j + 1
+        elif i < n and s[i] == "(":
+            depth, j = 0, i
+            while j < n:
+                if s[j] == "(":
+                    depth += 1
+                elif s[j] == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                j += 1
+            # strip interior whitespace so the value rides the
+            # single-token prop grammar downstream
+            out.append((key, re.sub(r"\s+", "", s[i:j + 1])))
+            i = j + 1
+        else:
+            mv = re.match(r"\S+", s[i:])
+            out.append((key, mv.group(0) if mv else ""))
+            i += len(mv.group(0)) if mv else 1
+    return out
 
 
 def _convert_global(line: str, fm) -> str:
@@ -105,8 +144,7 @@ def _convert_global(line: str, fm) -> str:
     if not m:
         return line
     name, props_src = m.group(1), m.group(2)
-    props = {k: (a or b)
-             for k, a, b in _RE_PROP_C.findall(props_src)}
+    props = dict(_scan_props_c(props_src))
     ctype = props.get("type", "")
     default = props.get("default")
     if "*" in ctype or "matrix" in ctype or "collection" in ctype \
@@ -273,9 +311,7 @@ def _convert_arrow_line(line: str, fm, task_names: set[str],
     if pm:
         props_src = pm.group(1)
         line = line[:pm.start()].rstrip()
-        kept = []
-        for k, a, b in _RE_PROP_C.findall(props_src):
-            kept.append(f"{k} = {a or b}")
+        kept = [f"{k} = {v}" for k, v in _scan_props_c(props_src)]
         if kept:
             props = "  [" + "  ".join(kept) + "]"
 
@@ -385,7 +421,10 @@ def _subst_ids(expr: str, mapping: dict[str, str]) -> str:
         v = mapping[w].strip()
         return v if re.fullmatch(r"\w+", v) else f"({v})"
 
-    return re.sub(r"\b\w+\b", rep, expr)
+    # (?<!\.) keeps attribute names out of the substitution: a task
+    # parameter named like a collection attribute (descA.nb vs param nb)
+    # must not rewrite the attribute access
+    return re.sub(r"(?<!\.)\b\w+\b", rep, expr)
 
 
 def _norm_expr(s: str | None) -> str:

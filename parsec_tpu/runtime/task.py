@@ -56,7 +56,7 @@ class Dep:
     """
 
     __slots__ = ("guard", "target_class", "target_flow", "target_params",
-                 "dtt", "data_ref", "null", "ranged")
+                 "dtt", "data_ref", "null", "ranged", "wire")
 
     def __init__(self, guard: Callable[[dict], bool] | None = None,
                  target_class: str | None = None,
@@ -64,7 +64,8 @@ class Dep:
                  target_params: Callable[[dict], tuple] | None = None,
                  dtt: Any = None,
                  data_ref: Callable[[dict], tuple] | None = None,
-                 null: bool = False, ranged: bool = False) -> None:
+                 null: bool = False, ranged: bool = False,
+                 wire: Any = None) -> None:
         self.guard = guard
         self.target_class = target_class
         self.target_flow = target_flow
@@ -76,6 +77,16 @@ class Dep:
         # dep expecting len(each_target) arrivals — the class switches from
         # mask to goal-counted dep tracking (dependencies_goal protocol)
         self.ranged = ranged
+        # partial-tile wire datatype (the JDF [type_remote/displ_remote]
+        # pair): a tuple of slices, or callable(locals) -> slices, naming
+        # the sub-view of the tile a REMOTE edge ships; local edges ignore
+        # it (data/datatype.py WireRegion)
+        self.wire = wire
+
+    def wire_slices(self, locals_: dict) -> tuple | None:
+        if self.wire is None:
+            return None
+        return self.wire(locals_) if callable(self.wire) else self.wire
 
     def active(self, locals_: dict) -> bool:
         return self.guard is None or bool(self.guard(locals_))

@@ -1,0 +1,154 @@
+"""Partial-tile wire datatypes — the reference's ``[type_remote = LR,
+displ_remote = ...]`` dep properties (``tests/apps/stencil/stencil_1D.jdf:
+83-92``; MPI derived datatypes + ``parsec_reshape.c`` underneath).
+
+Here the same contract is a :class:`WireRegion` sliced-payload path
+through remote_dep: remote neighbor edges ship only the R ghost columns,
+local edges still share the full tile, and the consumer body branches on
+shape exactly like the reference's ``CORE_copydata_stencil_1D``
+displacement logic branches on local-vs-remote buffers.
+"""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+from parsec_tpu import ptg
+from parsec_tpu.comm.multirank import run_multirank
+from parsec_tpu.data.datatype import WireRegion, wire_slice_key
+
+JDF_DIR = pathlib.Path(__file__).parent.parent / "examples" / "jdf"
+REF = pathlib.Path("/root/reference")
+needs_ref = pytest.mark.skipif(not REF.exists(),
+                               reason="reference tree not available")
+
+
+# ---------------------------------------------------------------------------
+# WireRegion displacement arithmetic
+# ---------------------------------------------------------------------------
+
+def test_wire_region_slices_follow_column_major_displacement():
+    """The reference displaces in BYTES through the tile's column-major
+    storage: sizeof*mb*c0 selects column c0 (stencil_1D.jdf:90-92)."""
+    mb, R = 8, 2
+    lr = WireRegion(mb, R, itemsize=4)
+    assert lr.slices(0) == (slice(None), slice(0, R))
+    # the AR ghost send: displ sizeof*mb*R -> columns [R, 2R)
+    assert lr.slices(4 * mb * R) == (slice(None), slice(R, 2 * R))
+    # the AL ghost send: displ sizeof*mb*(nb-2R) -> columns [nb-2R, nb-R)
+    nb = 16
+    assert lr.slices(4 * mb * (nb - 2 * R)) == \
+        (slice(None), slice(nb - 2 * R, nb - R))
+    assert lr.nbytes == mb * R * 4
+
+
+def test_wire_region_rejects_unaligned_displacement():
+    with pytest.raises(ValueError):
+        WireRegion(8, 2, itemsize=4).slices(6)
+
+
+def test_prop_values_parse_at_arbitrary_paren_depth():
+    """A depth-capped regex once misparsed deep displ_remote formulas as
+    bare flags (value True -> displ 1 -> wrong ghost columns, silently).
+    The scanner must keep balanced parens whole at any depth."""
+    from parsec_tpu.ptg.jdf import _parse_props
+    p = _parse_props(
+        "type_remote = LR  displ_remote = (sizeof*(mb*(nb-(2*R))))  flag")
+    assert p["type_remote"] == "LR"
+    assert p["displ_remote"] == "(sizeof*(mb*(nb-(2*R))))"
+    assert p["flag"] is True
+
+
+def test_subst_ids_leaves_attribute_names_alone():
+    """A task parameter named like a collection attribute must not
+    rewrite the attribute access during read-chain substitution."""
+    from parsec_tpu.ptg.jdf_c import _subst_ids
+    assert _subst_ids("descA.nb - nb", {"nb": "k+1"}) == \
+        "descA.nb - (k+1)"
+
+
+def test_wire_slice_key_hashable_identity():
+    k = wire_slice_key((slice(None), slice(2, 4)))
+    assert k == ((None, None, None), (2, 4, None))
+    assert hash(k)
+    assert wire_slice_key(None) is None
+
+
+# ---------------------------------------------------------------------------
+# the sliced-payload path, end to end over ranks
+# ---------------------------------------------------------------------------
+
+from test_jdf_reference import _stencil_desc, _stencil_oracle  # noqa: E402
+
+
+def _rank_body(wire_on):
+    def body(ctx, rank, nranks):
+        from parsec_tpu.core.params import params
+        params.set("comm_wire_datatypes", wire_on)
+        try:
+            MB, NB, LMT, LNT, R, iters = 4, 34, 2, 8, 1, 4
+            desc, interior = _stencil_desc(nranks, rank, MB, NB, LMT,
+                                           LNT, R, seed=7)
+            W = np.array([0.25, 0.5, 0.25])
+            jdf = ptg.load_jdf(JDF_DIR / "stencil_1D.jdf")
+            tp = jdf.build(descA=desc, iter=iters, R=R, W=W, LMT=LMT,
+                           LNT=LNT)
+            ctx.add_taskpool(tp)
+            ctx.wait(timeout=120)
+            ctx.comm_barrier()
+            want = _stencil_oracle(interior, W, iters)
+            m = iters % LMT
+            w = NB - 2 * R
+            for n in range(LNT):
+                if desc.rank_of(m, n) != rank:
+                    continue
+                tile = np.asarray(desc.data_of(m, n).newest_copy().value)
+                np.testing.assert_allclose(
+                    tile[:, R:NB - R], want[:, n * w:(n + 1) * w],
+                    rtol=1e-4, atol=1e-5)
+            return ctx.comm_engine.payload_bytes_staged
+        finally:
+            params.set("comm_wire_datatypes", True)
+    return body
+
+
+def test_stencil_wire_datatypes_cut_halo_bytes_multirank():
+    """The done-criterion of VERDICT r4 item 3: the translated stencil
+    ships R-column payloads on neighbor edges — byte counters prove the
+    reduction, numerics stay identical to the full-tile build.
+
+    With NB=34, R=1 every halo edge shrinks 34x; self-edges (A0, FULL)
+    still carry whole tiles, so the total shrinks by the halo share."""
+    nranks = 4
+    with_wire = sum(run_multirank(nranks, _rank_body(True)))
+    without = sum(run_multirank(nranks, _rank_body(False)))
+    assert with_wire < without * 0.55, (with_wire, without)
+    # exact accounting: per iteration each rank boundary moves two
+    # (MB, NB) tiles without wire datatypes and two (MB, R) regions with
+    # them — the A0 self-edges never cross ranks (column distribution),
+    # so the FULL share is zero here and the ratio approaches R/NB
+    assert with_wire <= without * (1 / 34) * 1.01, (with_wire, without)
+
+
+@needs_ref
+def test_reference_stencil_jdf_ingests_wire_datatypes():
+    """C-syntax ingestion maps the reference's own [type_remote = LR,
+    displ_remote = %{...%}] automatically: bind LR to a WireRegion at
+    build and the converted deps carry the wire views."""
+    from parsec_tpu.ptg.jdf_c import load_c_jdf
+
+    jdf = load_c_jdf(
+        REF / "tests" / "apps" / "stencil" / "stencil_1D.jdf",
+        bodies={"task": "pass"})
+    task = jdf.tasks["task"]
+    arrows = [a for f in task.flows for a in f.arrows]
+    wired = [a for a in arrows if a.props.get("type_remote") == "LR"]
+    # AL in, AR in, and the two neighbor sends
+    assert len(wired) == 4
+    sends = [a for a in wired if a.direction == "out"]
+    assert len(sends) == 2
+    assert all("displ_remote" in a.props for a in sends)
+    # the displ expressions converted to evaluable Python: check one
+    displs = sorted(a.props["displ_remote"] for a in sends)
+    assert any("mb" in d for d in displs)
