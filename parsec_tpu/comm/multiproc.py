@@ -51,7 +51,8 @@ def _free_port_base(nranks: int) -> int:
 
 
 def run_multiproc(nranks: int, target: str, timeout: float = 180.0,
-                  nb_cores: int = 0, transport: str = "socket") -> list[Any]:
+                  nb_cores: int = 0, transport: str = "socket",
+                  distributed: bool = False) -> list[Any]:
     """Run ``target`` on ``nranks`` subprocess ranks; returns the per-rank
     results.  Retries once on a lost port-range race (a bind collision
     surfaces as one rank failing, or as a timeout of the survivors).
@@ -61,6 +62,12 @@ def run_multiproc(nranks: int, target: str, timeout: float = 180.0,
     device-resident, and GETs land directly on the consumer's device
     (:mod:`parsec_tpu.comm.device_socket`, the deployable DCN tier).
 
+    ``distributed=True`` bootstraps ``jax.distributed`` across the ranks
+    first — a coordinator on 127.0.0.1 plus per-rank process ids, the
+    exact real-pod path of :func:`~parsec_tpu.comm.device_socket.
+    maybe_init_distributed` (each process then sees its local chips; on
+    the forced-CPU test backend, its own CPU device).
+
     Execution is therefore **at-least-once**: on the retry path every rank
     body runs again from scratch, so bodies with external side effects
     (files, network writes) must be idempotent or key their outputs by
@@ -69,17 +76,25 @@ def run_multiproc(nranks: int, target: str, timeout: float = 180.0,
     have let early ranks start their bodies before the failure surfaced."""
     if transport not in ("socket", "device"):
         raise ValueError(f"unknown transport {transport!r}")
+    if distributed and transport != "device":
+        # _rank_main bootstraps jax.distributed on the device-transport
+        # path only; silently skipping it would fail far from the cause
+        raise ValueError("distributed=True requires transport='device'")
     try:
-        return _run_multiproc(nranks, target, timeout, nb_cores, transport)
+        return _run_multiproc(nranks, target, timeout, nb_cores, transport,
+                              distributed)
     except (RuntimeError, TimeoutError) as e:
         if "Address already in use" not in str(e):
             raise
-        return _run_multiproc(nranks, target, timeout, nb_cores, transport)
+        return _run_multiproc(nranks, target, timeout, nb_cores, transport,
+                              distributed)
 
 
 def _run_multiproc(nranks: int, target: str, timeout: float,
-                   nb_cores: int, transport: str = "socket") -> list[Any]:
-    base = _free_port_base(nranks)
+                   nb_cores: int, transport: str = "socket",
+                   distributed: bool = False) -> list[Any]:
+    # one extra port for the jax.distributed coordinator when asked
+    base = _free_port_base(nranks + (1 if distributed else 0))
     tmp = tempfile.mkdtemp(prefix="parsec_mp_")
     env = dict(os.environ)
     # subprocess ranks must not grab the bench TPU (or a TPU plugin that
@@ -95,12 +110,19 @@ def _run_multiproc(nranks: int, target: str, timeout: float,
     env["PARSEC_MP_NB_CORES"] = str(nb_cores)
     env["PARSEC_MP_TIMEOUT"] = str(timeout)
     env["PARSEC_MP_TRANSPORT"] = transport
+    if distributed:
+        env["PARSEC_TPU_COORDINATOR"] = f"127.0.0.1:{base + nranks}"
+        env["PARSEC_TPU_NUM_PROCS"] = str(nranks)
+    else:
+        env.pop("PARSEC_TPU_COORDINATOR", None)
     procs: list[subprocess.Popen] = []
     logs: list[str] = []
     try:
         for r in range(nranks):
             e = dict(env)
             e["PARSEC_MP_RANK"] = str(r)
+            if distributed:
+                e["PARSEC_TPU_PROC_ID"] = str(r)
             e["PARSEC_MP_RESULT"] = os.path.join(tmp, f"rank{r}.pkl")
             log = os.path.join(tmp, f"rank{r}.log")
             logs.append(log)

@@ -78,13 +78,24 @@ def _wire_value(value: Any) -> Any:
 
 def _slice_view(value: Any, view_key: tuple) -> Any:
     """Cut the wire view out of a tile (host or device array).  The copy
-    is deliberate for host arrays: the wire must not alias the live tile
-    a local successor may be mutating."""
-    sl = tuple(slice(*s) if isinstance(s, (tuple, list)) else s
-               for s in view_key)
-    out = value[sl]
+    is unconditional for host arrays (``ascontiguousarray`` would alias
+    when the slice happens to be contiguous — e.g. 1-row tiles): the
+    wire must not alias the live tile a local successor may be mutating.
+    An out-of-range view is an error, not a silent clamp — numpy would
+    ship a SMALLER region and the consumer's shape branch would
+    misclassify it."""
+    sl = []
+    for axis, s in enumerate(view_key):
+        s = slice(*s) if isinstance(s, (tuple, list)) else s
+        if isinstance(s, slice) and s.stop is not None \
+                and s.stop > value.shape[axis]:
+            raise ValueError(
+                f"wire view {view_key} exceeds tile shape {value.shape} "
+                f"on axis {axis} (bad displ_remote?)")
+        sl.append(s)
+    out = value[tuple(sl)]
     if isinstance(out, np.ndarray):
-        out = np.ascontiguousarray(out)
+        out = np.array(out, copy=True)
     return out
 
 

@@ -357,7 +357,7 @@ class JDF:
         # region.slices(displ) to remote peers instead of the full tile.
         wire = None
         wname = ar.props.get("type_remote")
-        if wname is not None:
+        if wname is not None and isinstance(wname, str):
             from ..data.datatype import WireRegion
             region = (typeenv or {}).get(wname)
             if isinstance(region, WireRegion):
@@ -366,13 +366,10 @@ class JDF:
 
                 def wire(g, l, _r=region, _d=displ_fn):
                     return _r.slices(int(_d(g, l)) if _d else 0)
-            elif region is not None:
-                raise JDFError(
-                    f"line {ar.line}: [type_remote={wname}] must name a "
-                    f"WireRegion global or prologue binding (got "
-                    f"{type(region).__name__})")
-            # unbound name (e.g. FULL, or an arena the app never defines):
-            # full-tile wire — the reference's default datatype behavior
+            # any other binding (unbound FULL, a TileType doubling as the
+            # full-tile arena — the reference's `type = DEFAULT
+            # type_remote = DEFAULT` idiom, merge_sort.jdf) keeps the
+            # full-tile wire, the reference's default datatype behavior
         for tgt, gfn in ((ar.then_tgt, guard),
                         (ar.else_tgt, neg if ar.else_tgt else None)):
             if tgt is None:
@@ -595,6 +592,22 @@ _RE_PROP_KEY = re.compile(r"(\w+)\s*(=)?\s*")
 _RE_PROP_BARE = re.compile(r"[\w.\-*%/+]+")
 
 
+def scan_balanced(s: str, i: int) -> int:
+    """Index of the ``)`` closing the paren group opening at ``s[i]``
+    (arbitrary depth; ``len(s) - 1`` when unterminated).  Shared by the
+    native and C-syntax property scanners."""
+    depth, j, n = 0, i, len(s)
+    while j < n:
+        if s[j] == "(":
+            depth += 1
+        elif s[j] == ")":
+            depth -= 1
+            if depth == 0:
+                return j
+        j += 1
+    return n - 1
+
+
 def _parse_props(s: str | None) -> dict:
     """``key = value`` pairs and bare flags.  Values are either a
     balanced parenthesized expression at ARBITRARY depth (displ_remote
@@ -616,15 +629,7 @@ def _parse_props(s: str | None) -> dict:
             out[key] = True
             continue
         if i < n and s[i] == "(":
-            depth, j = 0, i
-            while j < n:
-                if s[j] == "(":
-                    depth += 1
-                elif s[j] == ")":
-                    depth -= 1
-                    if depth == 0:
-                        break
-                j += 1
+            j = scan_balanced(s, i)
             out[key] = s[i:j + 1]
             i = j + 1
         else:

