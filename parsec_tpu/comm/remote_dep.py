@@ -242,10 +242,8 @@ class RemoteDepEngine:
             try:
                 n = self.flush_outgoing() + self.ce.progress()
             except BaseException as e:   # surface like a worker failure:
-                with self.ctx._lock:     # a silent dead comm thread is a
-                    if self.ctx._worker_error is None:   # hang, not a crash
-                        self.ctx._worker_error = e
-                    self.ctx._cond.notify_all()
+                # a silent dead comm thread is a hang, not a crash
+                self.ctx.record_failure(e)
                 return
             if n:
                 backoff.reset()

@@ -72,6 +72,10 @@ class Context:
         # (the parsec_taskpool_reserve_id / sync_ids analog, parsec.c:2038)
         self._tp_by_comm_id: dict[int, Taskpool] = {}
         self._worker_error: BaseException | None = None
+        # whether the recorded failure has been raised to a caller —
+        # fini() re-raises a failure nobody has seen yet (a silently
+        # swallowed worker death would report clean success)
+        self._error_surfaced = False
 
         # devices: registry is process-global; the context snapshots it
         self.devices = device_registry
@@ -205,6 +209,16 @@ class Context:
         if startup:
             schedule_tasks(self._submit_es, list(startup), 0)
 
+    def record_failure(self, e: BaseException) -> None:
+        """Record a fatal background/driver failure (first one wins) and
+        wake every waiter — the one locked path all recording sites share
+        (worker threads, the comm thread, compiled-DAG drivers, the
+        caller-driven loop)."""
+        with self._lock:
+            if self._worker_error is None:
+                self._worker_error = e
+            self._cond.notify_all()
+
     def start(self) -> None:
         """``parsec_context_start``: open the barrier, wake the comm thread."""
         with self._lock:
@@ -231,8 +245,14 @@ class Context:
         self._drive_until(self.test, timeout)
 
     def fini(self) -> None:
-        """``parsec_fini``: drain, stop workers, release the scheduler."""
-        if not self.test():
+        """``parsec_fini``: drain, stop workers, release the scheduler.
+        A poisoned context (a recorded worker/driver failure) skips the
+        drain — its taskpools can never complete — and tears down like
+        :meth:`abort`; if no caller has seen the failure yet (it was
+        recorded by a background thread and never raised from a wait),
+        it is re-raised AFTER teardown so a crash cannot read as clean
+        success."""
+        if self._worker_error is None and not self.test():
             self.wait()
         with self._lock:
             self._shutdown = True
@@ -244,6 +264,10 @@ class Context:
         if self.comm_engine is not None:
             self.comm_engine.fini()
         self._props_teardown()
+        if self._worker_error is not None and not self._error_surfaced:
+            self._error_surfaced = True
+            raise RuntimeError(
+                "a background thread failed") from self._worker_error
 
     def __enter__(self) -> "Context":
         return self
@@ -308,18 +332,27 @@ class Context:
                 backoff.reset()
                 task_progress(es, task, distance)
             except BaseException as e:   # surface to waiters, don't hang
-                with self._lock:
-                    if self._worker_error is None:
-                        self._worker_error = e
-                    self._cond.notify_all()
+                self.record_failure(e)
                 return
 
     def _drive_until(self, predicate: Callable[[], bool],
                      timeout: float | None = None) -> None:
         """Progress from the calling thread until ``predicate`` holds.
+        Any failure that escapes to the caller (other than this wait's
+        own deadline expiry) marks the recorded context poison as
+        *surfaced* — fini() re-raises only failures nobody ever saw."""
+        try:
+            self._drive_until_inner(predicate, timeout)
+        except BaseException as e:
+            if not (isinstance(e, TimeoutError)
+                    and "context wait timed out" in str(e)):
+                self._error_surfaced = True
+            raise
 
-        With workers, just wait on the condition; without, run the hot loop
-        inline (master-thread funneled mode)."""
+    def _drive_until_inner(self, predicate: Callable[[], bool],
+                           timeout: float | None = None) -> None:
+        """With workers, just wait on the condition; without, run the hot
+        loop inline (master-thread funneled mode)."""
         if not self.started:
             self.start()
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -356,18 +389,32 @@ class Context:
                     "a background thread failed") from self._worker_error
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError("context wait timed out")
-            task, distance = select_task(es)
-            if task is None:
-                # pools enqueued mid-drive
-                self._run_compiled_dags(deadline=deadline)
-                if self.comm_engine is not None:
-                    self.comm_engine.progress(es)
-                if predicate():
-                    return
-                backoff.wait()
-                continue
-            backoff.reset()
-            task_progress(es, task, distance)
+            try:
+                task, distance = select_task(es)
+                if task is None:
+                    # pools enqueued mid-drive
+                    self._run_compiled_dags(deadline=deadline)
+                    if self.comm_engine is not None:
+                        self.comm_engine.progress(es)
+                    if predicate():
+                        return
+                    backoff.wait()
+                    continue
+                backoff.reset()
+                task_progress(es, task, distance)
+            except TimeoutError as e:
+                if "context wait timed out" in str(e):
+                    raise    # deadline expiry is not a context poison
+                self.record_failure(e)   # a body's timeout IS a failure
+                raise
+            except BaseException as e:
+                # an unrecoverable failure in the inline drive (device
+                # fail-stop escalation, comm progress on a dead peer)
+                # poisons the context: record it so a later fini() tears
+                # down instead of re-draining a pool that can never
+                # complete
+                self.record_failure(e)
+                raise
 
     def _has_pending_dag(self) -> bool:
         """A compiled pool still waiting for a driver (claimed-and-running
@@ -400,9 +447,7 @@ class Context:
             except BaseException as e:
                 # record the failure BEFORE terminating the pool: a waiter
                 # woken by the termination must see the error, not success
-                with self._lock:
-                    if self._worker_error is None:
-                        self._worker_error = e
+                self.record_failure(e)
                 tp._compiled_dag = None
                 tp.tdm.taskpool_addto_nb_tasks(-dag.ntasks)
                 raise

@@ -27,6 +27,22 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture
+def accel_device():
+    """A TPUDevice wrapping the host CPU jax device, registered for the
+    test and restored after (shared by the device/pressure suites)."""
+    from parsec_tpu.device import registry
+    from parsec_tpu.device.tpu import TPUDevice
+
+    snapshot = list(registry.devices)
+    dev = TPUDevice(jax.devices()[0])
+    registry.add(dev)
+    yield dev
+    registry.devices = snapshot
+    for i, d in enumerate(registry.devices):
+        d.device_index = i
+
+
+@pytest.fixture
 def param():
     """Scoped MCA-parameter override: set through the registry, restored
     at test exit (shared by every test module)."""

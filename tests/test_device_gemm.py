@@ -16,16 +16,7 @@ from parsec_tpu.models.tiled_gemm import (gemm_flops, tiled_gemm_fused,
 from parsec_tpu.runtime import Context
 
 
-@pytest.fixture
-def accel_device():
-    """Register a TPUDevice backed by a host jax device, restore after."""
-    snapshot = list(registry.devices)
-    dev = TPUDevice(jax.devices()[0])
-    registry.add(dev)
-    yield dev
-    registry.devices = snapshot
-    for i, d in enumerate(registry.devices):
-        d.device_index = i
+# accel_device fixture: shared in conftest.py
 
 
 def _mk_abc(M, N, K, mb, rng):

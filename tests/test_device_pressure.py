@@ -1,0 +1,211 @@
+"""Pressure/fault harness for the TPU device module (VERDICT r4 item 7).
+
+The reference exercises its 700-line GPU edge-case surface on real
+hardware in CI (``device_gpu.c:845-1528``, ``tests/CMakeLists.txt:70-72``
+gating); here the same paths are driven by *injected* faults against a
+TPUDevice wrapping the host CPU jax device — the module's logic is
+platform-independent XLA, so this coverage is real:
+
+- OOM during stage-in -> LRU eviction + deferred w2r drain, with the
+  byte-accounting invariants checked at every drain;
+- an XLA dispatch raising MID-RUN (relay reset) after earlier batches
+  left dirty device tiles -> salvage-writeback + demote + requeue, with
+  the salvaged values verified against the partial computation;
+- a salvage that cannot write back a newer-than-host tile -> fail-stop
+  escalation (wrong answers are worse than stopping);
+- the relay dying during stage-in (``device_put`` raising) -> the same
+  demote protocol from the H2D boundary.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from parsec_tpu.models.tiled_gemm import tiled_gemm_ptg
+from parsec_tpu.runtime import Context
+
+
+@pytest.fixture
+def dev(accel_device):
+    return accel_device    # shared conftest fixture, local name
+
+
+def _mk_abc(n, mb, seed):
+    from parsec_tpu.data_dist.matrix import TiledMatrix
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+    c = rng.standard_normal((n, n)).astype(np.float32)
+    return (a, b, c, TiledMatrix.from_dense("A", a, mb, mb),
+            TiledMatrix.from_dense("B", b, mb, mb),
+            TiledMatrix.from_dense("C", c, mb, mb))
+
+
+def test_eviction_accounting_invariants_hold_at_every_drain(dev):
+    """Under a 3-tile budget the w2r queue churns constantly; at every
+    drain boundary the byte ledgers must agree with the structures they
+    describe (a drift here is silent HBM over/under-subscription)."""
+    checks = {"n": 0}
+    real_drain = dev._drain_evictions
+
+    def checked_drain():
+        real_drain()
+        with dev._lru_lock:
+            assert dev._mem_bytes == sum(
+                getattr(c.value, "nbytes", 0)
+                for c in dev._mem_lru.values()), "LRU ledger drift"
+            assert dev._evict_bytes == sum(
+                getattr(c.value, "nbytes", 0) for c in dev._evict_q), \
+                "w2r ledger drift"
+            assert dev._mem_bytes >= 0 and dev._evict_bytes >= 0
+        checks["n"] += 1
+
+    dev._drain_evictions = checked_drain
+    dev._mem_budget = 3 * 16 * 16 * 4
+    a, b, c, A, B, C = _mk_abc(64, 16, 21)
+    ctx = Context(nb_cores=0)
+    ctx.add_taskpool(tiled_gemm_ptg(A, B, C, devices="tpu"))
+    ctx.wait(timeout=120)
+    dev.sync()
+    dev._drain_evictions = real_drain
+    dev.flush_cache()
+    ctx.fini()
+    np.testing.assert_allclose(C.to_dense(), c + a @ b, rtol=1e-3)
+    assert checks["n"] > 0 and dev.deferred_evictions > 0
+    # post-flush: everything accounted down to zero
+    assert dev._mem_bytes == 0 and dev._evict_bytes == 0
+    assert not dev._mem_lru and not dev._evict_q
+
+
+def test_mid_run_dispatch_failure_salvages_dirty_tiles_and_requeues(dev):
+    """Batches 1..k succeed and leave dirty C tiles device-resident; then
+    the relay 'resets' (the vmapped XLA call raises).  The manager must
+    salvage the PARTIAL results back to host copies, disable the device,
+    and requeue the uncompleted tasks onto the CPU incarnation — final
+    numerics prove both the salvage values and the requeue set were
+    exact (a dropped dirty tile or a double-run task shows up as a wrong
+    product)."""
+    a, b, c, A, B, C = _mk_abc(64, 16, 22)
+    tp = tiled_gemm_ptg(A, B, C, devices="auto")
+
+    # inject at the exact XLA-call boundary the relay would break
+    import jax as _jax
+    from parsec_tpu.ptg.lowering import find_traceable
+    real = _jax.jit(_jax.vmap(find_traceable("gemm").apply))
+    calls = {"n": 0}
+
+    def flaky(*args):
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise ConnectionResetError("relay reset mid-batch")
+        return real(*args)
+
+    dev._vmap_cache["gemm"] = flaky
+    ctx = Context(nb_cores=0)
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=120)
+    dev.sync()
+    ctx.fini()
+    assert calls["n"] > 2, "the failure was never injected"
+    assert dev.enabled is False
+    assert dev.executed_tasks > 0, "no batch succeeded before the reset"
+    np.testing.assert_allclose(C.to_dense(), c + a @ b, rtol=1e-3)
+
+
+def test_unsalvageable_dirty_tile_fails_stop(dev):
+    """A dirty device tile newer than its host copy that cannot write
+    back must STOP the run (recomputing on stale inputs silently
+    corrupts results — device_gpu.c's fail-stop discipline)."""
+    a, b, c, A, B, C = _mk_abc(32, 16, 23)
+    tp = tiled_gemm_ptg(A, B, C, devices="auto")
+
+    import jax as _jax
+    from parsec_tpu.ptg.lowering import find_traceable
+    real = _jax.jit(_jax.vmap(find_traceable("gemm").apply))
+    calls = {"n": 0}
+
+    def flaky(*args):
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise ConnectionResetError("relay reset")
+        return real(*args)
+
+    dev._vmap_cache["gemm"] = flaky
+
+    def broken_writeback(copy):
+        raise OSError("D2H path down")
+
+    dev._writeback = broken_writeback
+    ctx = Context(nb_cores=0)
+    ctx.add_taskpool(tp)
+    with pytest.raises(RuntimeError, match="could not be salvaged"):
+        ctx.wait(timeout=120)
+        dev.sync()
+    ctx.fini()
+
+
+def test_fini_reraises_never_surfaced_background_failure():
+    """A worker death recorded while the caller never wait()s must not
+    read as clean success: fini() tears down, then re-raises.  A failure
+    the caller already saw (raised from wait) is NOT raised twice."""
+    import time
+
+    from parsec_tpu import ptg
+
+    def mk_ctx():
+        p = ptg.PTGBuilder("boom", N=1)
+        t = p.task("T", i=ptg.span(0, 0))
+        t.flow("ctl", ptg.CTL)
+
+        def body(es, task, g, l):
+            raise ValueError("worker death")
+        t.body(body)
+        ctx = Context(nb_cores=1)
+        ctx.add_taskpool(p.build())
+        return ctx
+
+    # never-surfaced: poll without wait(), then fini raises
+    ctx = mk_ctx()
+    ctx.start()
+    deadline = time.monotonic() + 30
+    while ctx._worker_error is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert ctx._worker_error is not None
+    with pytest.raises(RuntimeError, match="background thread failed"):
+        ctx.fini()
+
+    # surfaced through wait() (as the raw body error on the caller-driven
+    # path, or wrapped when a worker recorded it first): fini stays silent
+    ctx = mk_ctx()
+    with pytest.raises((RuntimeError, ValueError)):
+        ctx.wait(timeout=30)
+    ctx.fini()
+
+
+def test_relay_disconnect_during_stage_in_demotes(dev, monkeypatch):
+    """The H2D boundary dies (device_put raises after N transfers): the
+    demote protocol must fire from the stage-in phase too, and the CPU
+    incarnations must finish with exact numerics."""
+    a, b, c, A, B, C = _mk_abc(64, 16, 24)
+    tp = tiled_gemm_ptg(A, B, C, devices="auto")
+
+    real_put = jax.device_put
+    calls = {"n": 0}
+
+    def flaky_put(x, device=None, **kw):
+        calls["n"] += 1
+        if calls["n"] > 5:
+            raise ConnectionResetError("relay reset during H2D")
+        return real_put(x, device, **kw)
+
+    monkeypatch.setattr(jax, "device_put", flaky_put)
+    ctx = Context(nb_cores=0)
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=120)
+    dev.sync()
+    ctx.fini()
+    monkeypatch.undo()
+    assert calls["n"] > 5, "the H2D failure was never injected"
+    assert dev.enabled is False
+    np.testing.assert_allclose(C.to_dense(), c + a @ b, rtol=1e-3)

@@ -18,15 +18,7 @@ from parsec_tpu.dtd import DTDTaskpool, INOUT, INPUT, OUTPUT
 from parsec_tpu.runtime import Context
 
 
-@pytest.fixture
-def accel_device():
-    snapshot = list(registry.devices)
-    dev = TPUDevice(jax.devices()[0])
-    registry.add(dev)
-    yield dev
-    registry.devices = snapshot
-    for i, d in enumerate(registry.devices):
-        d.device_index = i
+# accel_device fixture: shared in conftest.py
 
 
 # ---------------------------------------------------------------------------
