@@ -1,0 +1,113 @@
+"""Offline trace tooling (VERDICT r4 item 9): the dbpinfos-role stats
+CLI (``python -m parsec_tpu.prof.info``) and the parsec-dotmerger-role
+multi-rank DOT merger (``python -m parsec_tpu.prof.dotmerge``), both run
+against artifacts a REAL 2-process multirank run produced."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from parsec_tpu.comm.multiproc import run_multiproc
+
+BODIES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "mp_bodies.py")
+
+
+@pytest.fixture(scope="module")
+def trace_dir(tmp_path_factory):
+    """One 2-process run, per-rank .prof + .dot artifacts shared by the
+    tool tests."""
+    d = tmp_path_factory.mktemp("traces")
+    os.environ["PARSEC_TEST_TRACE_DIR"] = str(d)
+    try:
+        res = run_multiproc(2, f"{BODIES}:traced_chain_body", timeout=120)
+    finally:
+        os.environ.pop("PARSEC_TEST_TRACE_DIR", None)
+    assert res == [True, True]
+    for r in range(2):
+        assert (d / f"rank{r}.prof").exists()
+        assert (d / f"rank{r}.dot").exists()
+    return d
+
+
+def test_info_cli_summarizes_multirank_traces(trace_dir):
+    p = subprocess.run(
+        [sys.executable, "-m", "parsec_tpu.prof.info", "--validate",
+         str(trace_dir / "rank0.prof"), str(trace_dir / "rank1.prof")],
+        capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stderr[-1500:]
+    out = p.stdout
+    assert "rank0.prof" in out and "rank1.prof" in out
+    assert "task_exec" in out
+    assert "VALIDATION: ok" in out
+    # stats columns present
+    assert "count" in out and "mean" in out
+
+
+def test_info_summarize_returns_stats(trace_dir):
+    from parsec_tpu.prof.info import summarize
+    import io
+    buf = io.StringIO()
+    res = summarize(str(trace_dir / "rank0.prof"), out=buf, validate=True)
+    assert res["problems"] == []
+    st = res["classes"]["task_exec"]
+    assert st["count"] > 0 and st["total_ns"] > 0
+    assert st["min_ns"] <= st["max_ns"]
+
+
+def test_dotmerge_cli_unions_ranks_and_marks_cross_edges(trace_dir):
+    merged = trace_dir / "merged.dot"
+    p = subprocess.run(
+        [sys.executable, "-m", "parsec_tpu.prof.dotmerge",
+         str(trace_dir / "rank0.dot"), str(trace_dir / "rank1.dot"),
+         "-o", str(merged)],
+        capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stderr[-1500:]
+    from parsec_tpu.prof.dotmerge import parse_dot
+    nodes, edges = parse_dot(merged.read_text())
+    # the chain has 2*nranks tasks, each executed on exactly one rank
+    assert len(nodes) == 4
+    ranks = {attrs["ranks"] for attrs in nodes.values()}
+    assert ranks == {"0", "1"}              # both ranks contributed
+    # chain edges T_i -> T_{i+1}: the rank-boundary hops are cross-rank
+    cross = [(s, d) for (s, d, _l), a in edges.items()
+             if a.get("style") == "dashed"]
+    assert len(cross) >= 1, edges
+    # per-rank fragments only see their local halves; the union restores
+    # the full chain order
+    assert len(edges) >= 3
+
+
+def test_dotmerge_parse_round_trip(tmp_path):
+    """The parser consumes exactly what the grapher emits — including
+    PARALLEL edges (one per flow between the same task pair), which are
+    distinct dependencies and must both survive the merge."""
+    from parsec_tpu.prof.dotmerge import parse_dot, write_merged
+    src = tmp_path / "one.dot"
+    src.write_text('digraph dag {\n'
+                   '  "A_1" [label="A(1)" color="#e6194b"];\n'
+                   '  "B_1" [label="B(1)" color="#3cb44b"];\n'
+                   '  "A_1" -> "B_1" [label="X"];\n'
+                   '  "A_1" -> "B_1" [label="Y"];\n'
+                   '}\n')
+    stats = write_merged([str(src)], str(tmp_path / "out.dot"))
+    assert stats == {"nodes": 2, "edges": 2, "cross_rank_edges": 0}
+    nodes, edges = parse_dot((tmp_path / "out.dot").read_text())
+    assert nodes["A_1"]["label"] == "A(1)"
+    assert nodes["A_1"]["ranks"] == "0"
+    assert ("A_1", "B_1", "X") in edges and ("A_1", "B_1", "Y") in edges
+
+
+def test_dotmerge_rank_tag_from_filename(tmp_path):
+    """Shell globs sort rank10 before rank2: the rank tag must come from
+    the filename, not the argv position."""
+    from parsec_tpu.prof.dotmerge import merge
+    for r in (10, 2):
+        (tmp_path / f"rank{r}.dot").write_text(
+            f'digraph d {{\n  "T_{r}" [label="T({r})"];\n}}\n')
+    nodes, _ = merge([str(tmp_path / "rank10.dot"),
+                      str(tmp_path / "rank2.dot")])
+    assert nodes["T_10"]["ranks"] == "10"
+    assert nodes["T_2"]["ranks"] == "2"
