@@ -71,14 +71,16 @@ def test_eviction_accounting_invariants_hold_at_every_drain(dev):
     dev._drain_evictions = real_drain
     dev.flush_cache()
     ctx.fini()
-    np.testing.assert_allclose(C.to_dense(), c + a @ b, rtol=1e-3)
+    np.testing.assert_allclose(C.to_dense(), c + a @ b, rtol=1e-3,
+                               atol=1e-4)
     assert checks["n"] > 0 and dev.deferred_evictions > 0
     # post-flush: everything accounted down to zero
     assert dev._mem_bytes == 0 and dev._evict_bytes == 0
     assert not dev._mem_lru and not dev._evict_q
 
 
-def test_mid_run_dispatch_failure_salvages_dirty_tiles_and_requeues(dev):
+def test_mid_run_dispatch_failure_salvages_dirty_tiles_and_requeues(
+        dev, param):
     """Batches 1..k succeed and leave dirty C tiles device-resident; then
     the relay 'resets' (the vmapped XLA call raises).  The manager must
     salvage the PARTIAL results back to host copies, disable the device,
@@ -89,19 +91,16 @@ def test_mid_run_dispatch_failure_salvages_dirty_tiles_and_requeues(dev):
     a, b, c, A, B, C = _mk_abc(64, 16, 22)
     tp = tiled_gemm_ptg(A, B, C, devices="auto")
 
-    # inject at the exact XLA-call boundary the relay would break
-    import jax as _jax
-    from parsec_tpu.ptg.lowering import find_traceable
-    real = _jax.jit(_jax.vmap(find_traceable("gemm").apply))
+    # several small batches so failures land mid-run with dirty residue
+    param("device_tpu_batch_max", 8)
     calls = {"n": 0}
 
-    def flaky(*args):
+    def hook(batch):
         calls["n"] += 1
         if calls["n"] > 2:
             raise ConnectionResetError("relay reset mid-batch")
-        return real(*args)
 
-    dev._vmap_cache["gemm"] = flaky
+    dev._dispatch_hook = hook
     ctx = Context(nb_cores=0)
     ctx.add_taskpool(tp)
     ctx.wait(timeout=120)
@@ -110,28 +109,26 @@ def test_mid_run_dispatch_failure_salvages_dirty_tiles_and_requeues(dev):
     assert calls["n"] > 2, "the failure was never injected"
     assert dev.enabled is False
     assert dev.executed_tasks > 0, "no batch succeeded before the reset"
-    np.testing.assert_allclose(C.to_dense(), c + a @ b, rtol=1e-3)
+    np.testing.assert_allclose(C.to_dense(), c + a @ b, rtol=1e-3,
+                               atol=1e-4)
 
 
-def test_unsalvageable_dirty_tile_fails_stop(dev):
+def test_unsalvageable_dirty_tile_fails_stop(dev, param):
     """A dirty device tile newer than its host copy that cannot write
     back must STOP the run (recomputing on stale inputs silently
     corrupts results — device_gpu.c's fail-stop discipline)."""
     a, b, c, A, B, C = _mk_abc(32, 16, 23)
     tp = tiled_gemm_ptg(A, B, C, devices="auto")
 
-    import jax as _jax
-    from parsec_tpu.ptg.lowering import find_traceable
-    real = _jax.jit(_jax.vmap(find_traceable("gemm").apply))
+    param("device_tpu_batch_max", 4)
     calls = {"n": 0}
 
-    def flaky(*args):
+    def hook(batch):
         calls["n"] += 1
         if calls["n"] > 1:
             raise ConnectionResetError("relay reset")
-        return real(*args)
 
-    dev._vmap_cache["gemm"] = flaky
+    dev._dispatch_hook = hook
 
     def broken_writeback(copy):
         raise OSError("D2H path down")
@@ -183,19 +180,20 @@ def test_fini_reraises_never_surfaced_background_failure():
     ctx.fini()
 
 
-def test_relay_disconnect_during_stage_in_demotes(dev, monkeypatch):
+def test_relay_disconnect_during_stage_in_demotes(dev, monkeypatch, param):
     """The H2D boundary dies (device_put raises after N transfers): the
     demote protocol must fire from the stage-in phase too, and the CPU
     incarnations must finish with exact numerics."""
     a, b, c, A, B, C = _mk_abc(64, 16, 24)
     tp = tiled_gemm_ptg(A, B, C, devices="auto")
 
+    param("device_tpu_batch_max", 8)   # several batched transfers
     real_put = jax.device_put
     calls = {"n": 0}
 
     def flaky_put(x, device=None, **kw):
         calls["n"] += 1
-        if calls["n"] > 5:
+        if calls["n"] > 1:
             raise ConnectionResetError("relay reset during H2D")
         return real_put(x, device, **kw)
 
@@ -206,6 +204,7 @@ def test_relay_disconnect_during_stage_in_demotes(dev, monkeypatch):
     dev.sync()
     ctx.fini()
     monkeypatch.undo()
-    assert calls["n"] > 5, "the H2D failure was never injected"
+    assert calls["n"] > 1, "the H2D failure was never injected"
     assert dev.enabled is False
-    np.testing.assert_allclose(C.to_dense(), c + a @ b, rtol=1e-3)
+    np.testing.assert_allclose(C.to_dense(), c + a @ b, rtol=1e-3,
+                               atol=1e-4)
