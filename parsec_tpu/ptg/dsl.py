@@ -362,6 +362,23 @@ class TaskClassBuilder:
             def __getitem__(self, k):
                 raise LookupError(k)
 
+        # static box extents for the index-array dep-storage variant
+        # (parsec_default_find_deps / `-M index-array`): captured lazily
+        # at first use — like in_space's static capture below, so globals
+        # bound between build() and execution start are honored
+        def extents_fn() -> tuple | None:
+            try:
+                g = g_ns()
+                st = tuple(rngfn(g, _Poison())
+                           for rngfn in ranges.values())
+                if all(isinstance(r, range) and r.step == 1 for r in st):
+                    return tuple((r.start, r.stop) for r in st)
+            except Exception:
+                pass
+            return None
+
+        tc.space_extents_fn = extents_fn
+
         def in_space(locals_: dict) -> bool:
             st = cache["static"]
             if st is None:

@@ -18,7 +18,15 @@ import threading
 from typing import Any
 
 from ..core.hash_table import ConcurrentHashTable
+from ..core.params import params as _params
 from .task import Task, TaskClass
+
+_params.register(
+    "deps_storage", "hash",
+    "dep-tracker storage: 'hash' (parsec_hash_find_deps) or "
+    "'index-array' (parsec_default_find_deps — dense per-class arrays "
+    "over static execution-space boxes; non-eligible classes fall back "
+    "to the hashed tier)")
 
 # 64-bit key layout for the native dep table: [tpid:10][tcid:6][params:48].
 # Packing is *exact* (injective) or refused — a non-packable key falls back
@@ -67,15 +75,66 @@ class _DepTracker:
         self.goal = -1   # >= 0: counted mode (ranged deps), arrivals left
 
 
+class _IndexArrayStore:
+    """Dense per-(taskpool, class) tracker arrays over the static
+    execution-space box — the ``parsec_default_find_deps`` variant
+    (``parsec.c:1479``; ``-M index-array``, ``ptg-compiler/main.c:49``).
+    Slot index = row-major linearization of (param - lo) over the box;
+    triangular spaces waste the unused slots exactly like the
+    reference's multi-dimensional arrays do.  Each (taskpool, class)
+    array carries its own lock — slots of unrelated classes never
+    contend (the hashed tier's per-key locking analog)."""
+
+    __slots__ = ("_arrays", "_lock", "allocated", "releases")
+
+    def __init__(self) -> None:
+        self._arrays: dict[tuple, tuple] = {}   # akey -> (lock, list)
+        self._lock = threading.Lock()           # guards the dict only
+        self.allocated = 0    # arrays created (SDE-style engagement proof)
+        self.releases = 0     # dep records through the indexed tier
+
+    @staticmethod
+    def slot(extents: tuple, tkey: tuple) -> int | None:
+        if len(tkey) != len(extents):
+            return None
+        li = 0
+        for (lo, stop), v in zip(extents, tkey):
+            if type(v) is not int or v < lo or v >= stop:
+                return None
+            li = li * (stop - lo) + (v - lo)
+        return li
+
+    def array(self, taskpool: Any, tc: TaskClass) -> tuple:
+        """(lock, slots) for one (taskpool, class), created on first use."""
+        akey = (taskpool.taskpool_id, tc.task_class_id)
+        with self._lock:
+            entry = self._arrays.get(akey)
+            if entry is None:
+                size = 1
+                for lo, stop in tc.space_extents:
+                    size *= max(stop - lo, 0)
+                entry = self._arrays[akey] = (threading.Lock(),
+                                              [None] * size)
+                self.allocated += 1
+        return entry
+
+    def purge(self, taskpool_id: int) -> None:
+        with self._lock:
+            for k in [k for k in self._arrays if k[0] == taskpool_id]:
+                del self._arrays[k]
+
+
 class DependencyTracking:
     """One instance per context (cf. per-task-class ``parsec_dependencies_t``).
 
-    Two storage tiers share one protocol: the **native** C++ dep table
+    Storage tiers sharing one protocol: the **native** C++ dep table
     (mask bookkeeping behind one atomic call, keyed by an exact 64-bit
-    packing of the task identity) and the **Python** tracker table (any key
-    shape).  Data-carrying deps stash their input copies in a side dict
-    either way; the pure-CTL hot path (the dispatch benchmark's EP DAG)
-    never touches Python locks with the native tier on.
+    packing of the task identity), the **Python** tracker table (any key
+    shape), and — behind ``deps_storage=index-array`` — dense per-class
+    arrays over static execution-space boxes.  Data-carrying deps stash
+    their input copies in a side dict either way; the pure-CTL hot path
+    (the dispatch benchmark's EP DAG) never touches Python locks with
+    the native tier on.
     """
 
     def __init__(self) -> None:
@@ -83,9 +142,11 @@ class DependencyTracking:
         self._native = None
         self._inputs: dict[int, list] = {}    # k64 -> inputs ++ repo_refs
         self._inputs_lock = threading.Lock()
+        self._index_store = (_IndexArrayStore()
+                             if _params.get("deps_storage") == "index-array"
+                             else None)
         try:
             from .. import native            # registers runtime_native
-            from ..core.params import params as _params
             if _params.get("runtime_native") and native.available():
                 self._native = native.NativeDepTable()
         except Exception:
@@ -107,6 +168,16 @@ class DependencyTracking:
             return self._release_counted(taskpool, tc, locals_, tkey,
                                          flow_index, data_copy, repo_ref)
         bit = 1 << tc.dep_bit(flow_index, dep_index)
+        if self._index_store is not None and tc.find_deps_fn is None \
+                and tc.make_key_fn is None \
+                and tc.space_extents is not None:
+            # make_key_fn excluded: a UD key is injective but not
+            # positionally aligned with the param-range extents, so
+            # direct linearization could collide distinct tasks
+            li = _IndexArrayStore.slot(tc.space_extents, tkey)
+            if li is not None:
+                return self._release_indexed(taskpool, tc, locals_, li, bit,
+                                             flow_index, data_copy, repo_ref)
         if self._native is not None and tc.find_deps_fn is None:
             # UD keys with non-int elements refuse to pack and fall through
             k64 = _pack_key64(taskpool.taskpool_id, tc.task_class_id, tkey)
@@ -130,6 +201,40 @@ class DependencyTracking:
             ready = trk.satisfied_mask == trk.required_mask
             if ready:
                 self._table.remove(key)
+        if not ready:
+            return None
+        return self._make_ready(taskpool, tc, locals_, trk.inputs,
+                                trk.repo_refs)
+
+    def _release_indexed(self, taskpool: Any, tc: TaskClass, locals_: dict,
+                         li: int, bit: int, flow_index: int,
+                         data_copy: Any, repo_ref: Any) -> Task | None:
+        """The index-array variant's release: same mask protocol as the
+        hashed tier, tracker slot found by direct indexing."""
+        store = self._index_store
+        lock, arr = store.array(taskpool, tc)
+        with lock:
+            cur = store._arrays.get((taskpool.taskpool_id,
+                                     tc.task_class_id))
+            if cur is None or cur[1] is not arr:
+                # purged between lookup and lock (abort teardown racing a
+                # late release): drop the record — the pool is dying, and
+                # splitting bits across an orphaned tracker would hang it
+                return None
+            store.releases += 1
+            trk = arr[li]
+            if trk is None:
+                trk = arr[li] = _DepTracker(tc.input_dep_mask(locals_),
+                                            len(tc.flows))
+            assert not (trk.satisfied_mask & bit), \
+                f"dep {tc.name}[{li}] bit {bit} satisfied twice"
+            trk.satisfied_mask |= bit
+            if data_copy is not None:
+                trk.inputs[flow_index] = data_copy
+                trk.repo_refs[flow_index] = repo_ref
+            ready = trk.satisfied_mask == trk.required_mask
+            if ready:
+                arr[li] = None
         if not ready:
             return None
         return self._make_ready(taskpool, tc, locals_, trk.inputs,
@@ -207,6 +312,8 @@ class DependencyTracking:
         for key, _ in list(self._table.items()):
             if isinstance(key, tuple) and key and key[0] == taskpool_id:
                 self._table.remove(key)
+        if self._index_store is not None:
+            self._index_store.purge(taskpool_id)
 
     @property
     def native_enabled(self) -> bool:

@@ -37,6 +37,8 @@ DEV_RECURSIVE = "recursive"
 
 _task_counter = itertools.count()
 
+_UNSET = object()   # lazy-attribute sentinel (space_extents)
+
 
 class Dep:
     """One dependency edge endpoint on a flow (cf. ``parsec_dep_t``).
@@ -223,6 +225,15 @@ class TaskClass:
         # release like the reference's generated bounds checks — C-syntax
         # JDFs lean on this (`(k < NT) ? T PING(k+1)` at k = NT-1)
         self.in_space: Callable[[dict], bool] | None = None
+        # static execution-space box ((lo, stop) per param) when every
+        # range is locals-independent with unit step — enables the
+        # index-array dep-storage variant (parsec_default_find_deps,
+        # parsec.c:1479 / ptg-compiler `-M index-array`).  Resolved
+        # LAZILY at first use through space_extents_fn so globals bound
+        # after build() are honored, matching in_space's first-use
+        # capture of the same static ranges.
+        self.space_extents_fn: Callable[[], tuple | None] | None = None
+        self._space_extents: Any = _UNSET
         self.repo = None                  # DataRepo, attached by the taskpool
         # counted mode: any ranged input dep means arrivals are *counted*
         # toward a per-task goal instead of OR-ed into a bitmask (the
@@ -264,6 +275,13 @@ class TaskClass:
         return k
 
     # -- dep structure ------------------------------------------------------
+    @property
+    def space_extents(self) -> tuple | None:
+        if self._space_extents is _UNSET:
+            fn = self.space_extents_fn
+            self._space_extents = fn() if fn is not None else None
+        return self._space_extents
+
     def input_dep_mask(self, locals_: dict) -> int:
         """Bitmask of (flow_index, dep_index) input deps active for these
         locals — the per-task IN-dep mask (cf. ``parsec.c:1293``)."""
