@@ -29,10 +29,17 @@ What converts:
 - ``%option`` lines keep the options this grammar knows and drop the
   rest (``no_taskpool_instance``, ``dynamic`` — process-model artifacts).
 
-What does NOT convert: **C task bodies**.  Pass ``bodies`` mapping task
-names to Python body source (flow names are in scope, like any JDF
-body); unmapped bodies become ``pass`` — structure-only ingestion, which
-is exactly what graph/protocol tests need.
+C task bodies: the **mechanical statement subset** auto-converts —
+pointer-cast flow aliases (``int *Aint = (int*)A;``), deref
+assignments/compound assignments (``*Aint = k+1``, ``*Aint += 1``),
+plain declarations, ``if``/``else`` blocks, ``return``, and ``printf``
+(dropped: output side effects carry no dataflow) — which covers the
+reference's Ex02/Ex05/Ex06/Ex07 bodies verbatim.  Anything outside the
+subset (C function calls, loops, pointer arithmetic) falls back: pass
+``bodies`` mapping task names to Python body source (flow names in
+scope, like any JDF body); unmapped unconvertible bodies become
+``pass`` — structure-only ingestion, which is what graph/protocol
+tests need.
 
 Out-of-space successor arrows (``(k < NT) ? T PING(k+1)`` at
 ``k = NT-1``, ``rtt.jdf:16``) rely on the generated bounds check; the
@@ -55,7 +62,7 @@ from __future__ import annotations
 import re
 from typing import Any
 
-from .jdf import JDF, parse_jdf
+from .jdf import JDF, parse_jdf, scan_balanced
 
 # reference descriptor field -> this repo's collection attribute
 _FIELD_MAP = {
@@ -152,6 +159,161 @@ def _convert_global(line: str, fm) -> str:
     return f"{head}  [type = {out_type}]"
 
 
+_RE_PTR_DECL = re.compile(
+    r"^(?:\w+\s+)+\*\s*(\w+)\s*=\s*\(\s*\w+\s*\*\s*\)\s*(\w+)$")
+_RE_PLAIN_DECL = re.compile(
+    r"^(?:int|float|double|long|unsigned|size_t)\s+(\w+)\s*=\s*(.+)$")
+_RE_C_ASSIGN = re.compile(r"^(\*?\s*\w+)\s*(=(?!=)|\+=|-=|\*=)\s*(.+)$")
+
+
+def _convert_rhs(rhs: str, aliases: set[str], fm) -> str | None:
+    """Convert an expression of the simple subset, or None.  The subset
+    has no function calls and no C-only operators: the converted text
+    must compile as a Python expression and contain no call syntax —
+    otherwise the body degrades to the override/pass fallback instead
+    of shipping Python that crashes at build or task time."""
+    out = convert_expr(_deref(rhs, aliases), fm)
+    if re.search(r"[\w\]]\s*\(", out):
+        return None                      # calls are outside the subset
+    try:
+        compile(out, "<jdf_c:body>", "eval")
+    except SyntaxError:
+        return None                      # e.g. a leftover C ternary
+    return out
+
+
+def convert_c_body(src: str, field_map: dict | None = None) -> str | None:
+    """Mechanically convert a C task body of the simple statement subset
+    to Python, or return None when any statement falls outside it.
+
+    The subset (all the reference's Ex02/Ex05/Ex06/Ex07 bodies): flow
+    pointer aliases (``int *Aint = (int*)A;`` — tiles are numpy arrays,
+    ``*Aint`` becomes ``Aint[0]``), assignments and compound assignments
+    through the deref, plain arithmetic declarations, ``if``/``else``
+    with braced or single statements, ``return``, and ``printf`` calls
+    (dropped — output side effects carry no dataflow)."""
+    s = src.strip()
+    if s.startswith("{") and s.endswith("}"):
+        s = s[1:-1]
+    aliases: set[str] = set()
+    lines: list[str] = []
+    if _c_stmts(s, lines, "", aliases, field_map) is None:
+        return None
+    out = "\n".join(ln for ln in lines if ln.strip())
+    return out or "pass"
+
+
+def _deref(expr: str, aliases: set[str]) -> str:
+    """``*Aint`` -> ``Aint[0]`` for known pointer aliases (the simple
+    subset has no pointer arithmetic, so every ``* alias`` is a deref)."""
+    for a in aliases:
+        expr = re.sub(r"\*\s*" + a + r"\b", f"{a}[0]", expr)
+    return expr
+
+
+def _c_stmts(s: str, lines: list[str], indent: str, aliases: set[str],
+             fm) -> bool | None:
+    """Convert a statement sequence; None = outside the subset."""
+    i, n = 0, len(s)
+    while i < n:
+        while i < n and s[i] in " \t\r\n":
+            i += 1
+        if i >= n:
+            break
+        if s.startswith("if", i) and re.match(r"if\b", s[i:]):
+            j = s.find("(", i)
+            if j < 0:
+                return None
+            k = scan_balanced(s, j)
+            cond = _convert_rhs(s[j + 1:k], aliases, fm)
+            if cond is None:
+                return None
+            lines.append(f"{indent}if {cond}:")
+            i = _c_block(s, k + 1, lines, indent + "    ", aliases, fm)
+            if i is None:
+                return None
+            while i < n and s[i] in " \t\r\n":
+                i += 1
+            if s.startswith("else", i):
+                lines.append(f"{indent}else:")
+                i = _c_block(s, i + 4, lines, indent + "    ", aliases, fm)
+                if i is None:
+                    return None
+            continue
+        j = s.find(";", i)
+        if j < 0:
+            return None
+        if _c_stmt(s[i:j].strip(), lines, indent, aliases, fm) is None:
+            return None
+        i = j + 1
+    return True
+
+
+def _c_block(s: str, i: int, lines: list[str], indent: str,
+             aliases: set[str], fm) -> int | None:
+    """One braced block or single statement starting at/after ``i``;
+    returns the index past it."""
+    n = len(s)
+    while i < n and s[i] in " \t\r\n":
+        i += 1
+    if i < n and s[i] == "{":
+        depth, j = 0, i
+        while j < n:
+            if s[j] == "{":
+                depth += 1
+            elif s[j] == "}":
+                depth -= 1
+                if depth == 0:
+                    break
+            j += 1
+        if depth != 0:
+            return None
+        if _c_stmts(s[i + 1:j], lines, indent, aliases, fm) is None:
+            return None
+        return j + 1
+    j = s.find(";", i)
+    if j < 0:
+        return None
+    if _c_stmt(s[i:j].strip(), lines, indent, aliases, fm) is None:
+        return None
+    return j + 1
+
+
+def _c_stmt(stmt: str, lines: list[str], indent: str, aliases: set[str],
+            fm) -> bool | None:
+    if not stmt:
+        return True
+    m = _RE_PTR_DECL.match(stmt)
+    if m:
+        name, flow = m.groups()
+        aliases.add(name)
+        lines.append(f"{indent}{name} = {flow}")
+        return True
+    if re.match(r"printf\s*\(", stmt):
+        lines.append(f"{indent}pass  # {' '.join(stmt.split())}")
+        return True
+    if stmt == "return":
+        lines.append(f"{indent}return")
+        return True
+    m = _RE_PLAIN_DECL.match(stmt)
+    if m:
+        name, rhs = m.groups()
+        conv = _convert_rhs(rhs, aliases, fm)
+        if conv is None:
+            return None
+        lines.append(f"{indent}{name} = {conv}")
+        return True
+    m = _RE_C_ASSIGN.match(stmt)
+    if m:
+        lhs, op, rhs = m.groups()
+        conv = _convert_rhs(rhs, aliases, fm)
+        if conv is None:
+            return None
+        lines.append(f"{indent}{_deref(lhs.strip(), aliases)} {op} {conv}")
+        return True
+    return None
+
+
 def convert_c_jdf(text: str, bodies: dict[str, str] | None = None,
                   field_map: dict[str, str] | None = None) -> str:
     """Rewrite a C-syntax JDF into the Python-expression grammar."""
@@ -201,7 +363,11 @@ def convert_c_jdf(text: str, bodies: dict[str, str] | None = None,
                 i += 1
             i += 1  # consume END
             out.append("BODY")
-            body = bodies.get(cur_task or "", "pass")
+            body = bodies.get(cur_task or "")
+            if body is None:
+                # no override: try the mechanical C-statement subset
+                body = convert_c_body("\n".join(depth_body),
+                                      field_map) or "pass"
             for bl in body.split("\n"):
                 out.append("  " + bl)
             out.append("END")

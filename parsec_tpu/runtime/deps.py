@@ -85,11 +85,16 @@ class _IndexArrayStore:
     array carries its own lock — slots of unrelated classes never
     contend (the hashed tier's per-key locking analog)."""
 
-    __slots__ = ("_arrays", "_lock", "allocated", "releases")
+    __slots__ = ("_arrays", "_lock", "_dead", "allocated", "releases")
 
     def __init__(self) -> None:
         self._arrays: dict[tuple, tuple] = {}   # akey -> (lock, list)
         self._lock = threading.Lock()           # guards the dict only
+        # purged taskpool ids: a late release racing teardown must NOT
+        # resurrect the array (a context-lifetime leak of a dense array
+        # plus stashed inputs); ids are per-context monotonically
+        # assigned, so the set is bounded by finished pools
+        self._dead: set[int] = set()
         self.allocated = 0    # arrays created (SDE-style engagement proof)
         self.releases = 0     # dep records through the indexed tier
 
@@ -104,10 +109,13 @@ class _IndexArrayStore:
             li = li * (stop - lo) + (v - lo)
         return li
 
-    def array(self, taskpool: Any, tc: TaskClass) -> tuple:
-        """(lock, slots) for one (taskpool, class), created on first use."""
+    def array(self, taskpool: Any, tc: TaskClass) -> tuple | None:
+        """(lock, slots) for one (taskpool, class), created on first use;
+        None for a purged taskpool (a late release must not resurrect)."""
         akey = (taskpool.taskpool_id, tc.task_class_id)
         with self._lock:
+            if taskpool.taskpool_id in self._dead:
+                return None
             entry = self._arrays.get(akey)
             if entry is None:
                 size = 1
@@ -120,6 +128,7 @@ class _IndexArrayStore:
 
     def purge(self, taskpool_id: int) -> None:
         with self._lock:
+            self._dead.add(taskpool_id)
             for k in [k for k in self._arrays if k[0] == taskpool_id]:
                 del self._arrays[k]
 
@@ -212,7 +221,10 @@ class DependencyTracking:
         """The index-array variant's release: same mask protocol as the
         hashed tier, tracker slot found by direct indexing."""
         store = self._index_store
-        lock, arr = store.array(taskpool, tc)
+        entry = store.array(taskpool, tc)
+        if entry is None:
+            return None    # taskpool already purged: late release dropped
+        lock, arr = entry
         with lock:
             cur = store._arrays.get((taskpool.taskpool_id,
                                      tc.task_class_id))
