@@ -62,19 +62,30 @@ def stencil_2d_ptg(M: Any, weights: Any, iterations: int) -> ptg.PTGTaskpool:
     fc.output(succ=("ST", "C",
                     lambda g, l: {"t": l.t + 1, "i": l.i, "j": l.j}),
               guard=lambda g, l: l.t < g.T - 1)
-    # halo fan-out: this tile is next iteration's N/S/E/W ghost source
+    # halo fan-out: this tile is next iteration's N/S/E/W ghost source.
+    # Each edge carries a wire view ([type_remote] role): a remote
+    # neighbor receives ONLY its ghost row/column — the body's edge
+    # slicing is idempotent on the region (their last row of a 1-row
+    # payload is the payload), so local fulls and remote regions need no
+    # special-casing.  mb x nb tiles ship mb (or nb) elements instead of
+    # mb*nb on every cross-rank halo edge.
+    _all = slice(None)
     fc.output(succ=("ST", "N",
                     lambda g, l: {"t": l.t + 1, "i": l.i + 1, "j": l.j}),
-              guard=lambda g, l: l.t < g.T - 1 and l.i < g.MT - 1)
+              guard=lambda g, l: l.t < g.T - 1 and l.i < g.MT - 1,
+              wire=(slice(-1, None), _all))       # their north = my last row
     fc.output(succ=("ST", "S",
                     lambda g, l: {"t": l.t + 1, "i": l.i - 1, "j": l.j}),
-              guard=lambda g, l: l.t < g.T - 1 and l.i > 0)
+              guard=lambda g, l: l.t < g.T - 1 and l.i > 0,
+              wire=(slice(0, 1), _all))           # their south = my first row
     fc.output(succ=("ST", "W",
                     lambda g, l: {"t": l.t + 1, "i": l.i, "j": l.j + 1}),
-              guard=lambda g, l: l.t < g.T - 1 and l.j < g.NT - 1)
+              guard=lambda g, l: l.t < g.T - 1 and l.j < g.NT - 1,
+              wire=(_all, slice(-1, None)))       # their west = my last col
     fc.output(succ=("ST", "E",
                     lambda g, l: {"t": l.t + 1, "i": l.i, "j": l.j - 1}),
-              guard=lambda g, l: l.t < g.T - 1 and l.j > 0)
+              guard=lambda g, l: l.t < g.T - 1 and l.j > 0,
+              wire=(_all, slice(0, 1)))           # their east = my first col
     fc.output(data=("M", lambda g, l: (l.i, l.j)),
               guard=lambda g, l: l.t == g.T - 1)
 

@@ -89,5 +89,49 @@ def _rank_body(ctx, rank, nranks):
 
 def test_stencil2d_multirank_2x2():
     """The 2-D halo over a 2x2 rank grid: every ghost edge crosses a
-    rank boundary somewhere."""
+    rank boundary somewhere (and, since round 5, carries only its ghost
+    row/column over the wire)."""
     assert all(run_multirank(4, _rank_body))
+
+
+def _band_body(wire_on):
+    def body(ctx, rank, nranks):
+        from parsec_tpu.core.params import params
+        saved = params.get("comm_wire_datatypes")
+        params.set("comm_wire_datatypes", wire_on)
+        try:
+            # row bands (P=4, Q=1): every N/S halo edge crosses ranks,
+            # every E/W edge stays local — the wire views are unique per
+            # receiving rank, so no conflict-degrade to full tiles
+            mb = 8
+            dense, M = _grid(4 * mb, 2 * mb, mb, mb, nranks=nranks,
+                             rank=rank, P=4, Q=1, seed=9)
+            tp = stencil_2d_ptg(M, W, 3)
+            ctx.add_taskpool(tp)
+            ctx.wait(timeout=180)
+            ctx.comm_barrier()
+            want = stencil2d_reference(dense, W, 3)
+            for i in range(M.mt):
+                for j in range(M.nt):
+                    if M.rank_of(i, j) != rank:
+                        continue
+                    got = np.asarray(M.data_of(i, j).newest_copy().value)
+                    np.testing.assert_allclose(
+                        got, want[i * mb:(i + 1) * mb,
+                                  j * mb:(j + 1) * mb],
+                        rtol=1e-4, atol=1e-5)
+            return ctx.comm_engine.payload_bytes_staged
+        finally:
+            params.set("comm_wire_datatypes", saved)
+    return body
+
+
+def test_stencil2d_halo_wire_views_cut_bytes():
+    """Each cross-rank halo edge ships one mb-element ghost row instead
+    of the mb x mb tile: byte counters prove the exact mb-fold cut,
+    numerics identical to the full-tile build."""
+    with_wire = sum(run_multirank(4, _band_body(True)))
+    without = sum(run_multirank(4, _band_body(False)))
+    assert with_wire * 7 < without, (with_wire, without)
+    # exact: every remote payload is one 8-element row vs an 8x8 tile
+    assert with_wire == without // 8, (with_wire, without)

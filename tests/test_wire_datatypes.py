@@ -132,6 +132,7 @@ from test_jdf_reference import _stencil_desc, _stencil_oracle  # noqa: E402
 def _rank_body(wire_on):
     def body(ctx, rank, nranks):
         from parsec_tpu.core.params import params
+        saved = params.get("comm_wire_datatypes")
         params.set("comm_wire_datatypes", wire_on)
         try:
             MB, NB, LMT, LNT, R, iters = 4, 34, 2, 8, 1, 4
@@ -156,7 +157,7 @@ def _rank_body(wire_on):
                     rtol=1e-4, atol=1e-5)
             return ctx.comm_engine.payload_bytes_staged
         finally:
-            params.set("comm_wire_datatypes", True)
+            params.set("comm_wire_datatypes", saved)
     return body
 
 
