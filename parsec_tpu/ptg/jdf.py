@@ -202,6 +202,13 @@ class JDF:
         for src in self.prologue_src:
             exec(compile(src, f"<jdf:{self.name}:prologue>", "exec"), ns)
         ns.pop("__builtins__", None)
+        # <math.h> equivalents for expressions (reference JDFs compute
+        # e.g. reduction-tree depths with ceil/log in global defaults);
+        # prologue definitions win.  NOT `pow`: math.pow would shadow the
+        # int-preserving builtin every Python-grammar JDF already sees
+        import math as _math
+        for _mn in ("ceil", "floor", "log", "log2", "sqrt", "fabs"):
+            ns.setdefault(_mn, getattr(_math, _mn))
         self._last_ns = ns    # introspection: tests/tools peek at prologue state
 
         for gname, props in self.globals_decl.items():

@@ -87,12 +87,61 @@ def convert_expr(s: str, field_map: dict[str, str] | None = None) -> str:
     s = s.replace("&&", " and ").replace("||", " or ")
     s = re.sub(r"!(?![=])", " not ", s)
     s = s.replace("->", ".")
+    # C casts over a call: (int)ceil(...) -> int(ceil(...)) — the shape
+    # reference defaults use (reduce_col.jdf's tree depth); math names
+    # resolve from the build env (jdf.py exposes <math.h> equivalents)
+    s = re.sub(
+        r"\(\s*(?:int|long|unsigned|size_t)\s*\)\s*"
+        r"(\w+\s*\([^()]*(?:\([^()]*\)[^()]*)*\))",
+        r"int(\1)", s)
+    s = re.sub(
+        r"\(\s*(?:float|double)\s*\)\s*"
+        r"(\w+\s*\([^()]*(?:\([^()]*\)[^()]*)*\))",
+        r"float(\1)", s)
     for k, v in sorted(fm.items(), key=lambda kv: -len(kv[0])):
         s = s.replace("." + k, "." + v)
     # integral division (C semantics for the non-negative index math JDFs
-    # do); '//' stays itself
-    s = re.sub(r"(?<!/)/(?!/)", "//", s)
+    # do); '//' stays itself.  An expression doing FLOAT math — a decimal
+    # literal or a float-returning <math.h> call anywhere in it — keeps
+    # true division: C's '/' on doubles is float division, and flooring
+    # log(mt)/log(2.0) would silently drop a reduction-tree level at
+    # every power-of-two size
+    if not re.search(r"\d\.\d|\d\.(?!\w)|"
+                     r"\b(?:log|log2|sqrt|fabs|pow)\s*\(", s):
+        s = re.sub(r"(?<!/)/(?!/)", "//", s)
     return re.sub(r"\s+", " ", s).strip()
+
+
+def _strip_line_comments(text: str) -> str:
+    """Remove C ``//`` line comments, leaving string literals intact."""
+    out = []
+    for line in text.split("\n"):
+        res: list[str] = []
+        in_str: str | None = None
+        i, n = 0, len(line)
+        while i < n:
+            ch = line[i]
+            if in_str:
+                res.append(ch)
+                if ch == "\\" and i + 1 < n:
+                    res.append(line[i + 1])
+                    i += 2
+                    continue
+                if ch == in_str:
+                    in_str = None
+                i += 1
+                continue
+            if ch in "\"'":
+                in_str = ch
+                res.append(ch)
+                i += 1
+                continue
+            if ch == "/" and i + 1 < n and line[i + 1] == "/":
+                break
+            res.append(ch)
+            i += 1
+        out.append("".join(res))
+    return "\n".join(out)
 
 
 def _convert_inline(s: str, fm) -> str:
@@ -322,6 +371,10 @@ def convert_c_jdf(text: str, bodies: dict[str, str] | None = None,
     # strip C comments OUTSIDE bodies later; blanket-strip block comments
     # now (C-syntax files comment with /* */ everywhere, incl. body stubs)
     text = re.sub(r"/\*.*?\*/", "", text, flags=re.S)
+    # line comments too (BEFORE inline conversion: converted expressions
+    # legitimately contain Python's // floor division); string-literal
+    # aware, so a '//' inside a printf format survives
+    text = _strip_line_comments(text)
     text = _convert_inline(text, field_map)
 
     out: list[str] = []
@@ -428,10 +481,29 @@ def convert_c_jdf(text: str, bodies: dict[str, str] | None = None,
     return "\n".join(header + [body_text])
 
 
+def _open_ternary(line: str) -> bool:
+    """A paren-top-level ``?`` still awaiting its ``:`` — the reference
+    wraps guarded arrows across lines (``ep.jdf``'s else branch on its
+    own ``: S TASK(i, l-1)`` line)."""
+    depth, q = 0, 0
+    for ch in line:
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        elif depth == 0:
+            if ch == "?":
+                q += 1
+            elif ch == ":" and q > 0:
+                q -= 1
+    return q > 0
+
+
 def _merge_continuations(lines: list[str]) -> list[str]:
     """Join lines whose ``[...]`` dep-property block spans several source
-    lines (the reference wraps long property lists); BODY regions are C
-    code and stay untouched."""
+    lines (the reference wraps long property lists), and ternary-else
+    continuation lines (``: target`` / ``? target`` under an open
+    top-level ``?``); BODY regions are C code and stay untouched."""
     out: list[str] = []
     i, n = 0, len(lines)
     in_body = False
@@ -449,12 +521,26 @@ def _merge_continuations(lines: list[str]) -> list[str]:
             out.append(line)
             i += 1
             continue
-        depth = line.count("[") - line.count("]")
-        while depth > 0 and i + 1 < n:
-            i += 1
-            nxt = lines[i]
-            line = line.rstrip() + " " + nxt.strip()
-            depth += nxt.count("[") - nxt.count("]")
+        merged = True
+        while merged and i + 1 < n:
+            merged = False
+            depth = line.count("[") - line.count("]")
+            while depth > 0 and i + 1 < n:
+                i += 1
+                nxt = lines[i]
+                line = line.rstrip() + " " + nxt.strip()
+                depth += nxt.count("[") - nxt.count("]")
+                merged = True
+            if i + 1 < n:
+                nxt = lines[i + 1].strip()
+                arrowish = "<-" in line or "->" in line
+                # `? then` continues an arrow whose guard sat alone on
+                # the previous line; `: else` continues an open ternary
+                if (nxt.startswith("?") and arrowish) or (
+                        nxt.startswith(":") and _open_ternary(line)):
+                    i += 1
+                    line = line.rstrip() + " " + nxt
+                    merged = True
         out.append(line)
         i += 1
     return out

@@ -32,6 +32,39 @@ def test_convert_expr():
     assert convert_expr("(1<<n)-1") == "(1<<n)-1"
 
 
+def test_convert_expr_float_math_keeps_true_division():
+    """C's '/' on doubles is float division: an expression doing float
+    math must NOT get the integral-index '//' rewrite — flooring
+    log(mt)/log(2.0) would drop a reduction-tree level at every
+    power-of-two size (the reduce_col.jdf depth default)."""
+    got = convert_expr("(int)ceil(log(src->mt) / log(2.0))")
+    assert got == "int(ceil(log(src.mt) / log(2.0)))"
+    import math
+    for mt, want in ((8, 3), (64, 6), (128, 7)):
+        env = {"src": type("S", (), {"mt": mt})(), "ceil": math.ceil,
+               "log": math.log, "int": int}
+        assert eval(got, env) == want
+    # pure index math still floors
+    assert convert_expr("(m+1)/2") == "(m+1)//2"
+
+
+def test_line_comments_stripped_outside_strings():
+    """A '//' inside a C string literal is not a comment; one outside
+    is.  A mangled printf would knock the whole body out of the
+    mechanical subset and silently drop its dataflow writes."""
+    from parsec_tpu.ptg.jdf_c import _strip_line_comments, convert_c_body
+    s = _strip_line_comments('x = 1; // gone\ny = "kept // inside";')
+    assert s == 'x = 1; \ny = "kept // inside";'
+    # the pipeline strips comments before body conversion: a printf
+    # containing '//' must survive the strip and the body still convert
+    got = convert_c_body(_strip_line_comments(
+        '{ int *A0 = (int*)A;\n'
+        '  printf("a // b\\n", k);  // trailing\n'
+        '  *A0 = k+1; }'))
+    assert got is not None and "A0[0] = k+1" in got
+    assert 'a // b' in got          # the format string rode through
+
+
 # ---------------------------------------------------------------------------
 # the reference's own files
 # ---------------------------------------------------------------------------
@@ -143,6 +176,63 @@ def test_ex07_c_bodies_run_verbatim():
     for k in range(nodes):
         assert int(np.asarray(md.data_of(k).newest_copy().value)[0]) \
             == -k - 1
+
+
+@needs_ref
+def test_reference_jdf_parse_coverage():
+    """The converter swallows a broad slice of the reference's own .jdf
+    corpus: multi-line ternaries (ep.jdf's else on its own line,
+    reduce_col's guard/then/else on three), // line comments, multi-line
+    global declarations with C-math defaults, CUDA-era files."""
+    expected = {
+        "tests/runtime/scheduling/ep.jdf": {"INIT", "TASK"},
+        "tests/runtime/multichain.jdf": {"HORIZONTAL", "VERTICAL"},
+        "tests/runtime/cuda/stress.jdf":
+            {"DISCARD_C", "GEMM", "MAKE_C", "READ_A"},
+        "tests/dsl/ptg/complex_deps.jdf":
+            {"FCT1", "FCT2", "FCT3", "FCT4", "FCT5"},
+        "tests/dsl/ptg/controlgather/ctlgat.jdf": {"TA", "TB", "TC"},
+        "parsec/data_dist/matrix/reduce_col.jdf":
+            {"reduce_col", "reduce_in_col"},
+        "parsec/data_dist/matrix/reduce_row.jdf":
+            {"reduce_in_row", "reduce_row"},
+        "parsec/data_dist/matrix/apply.jdf":
+            {"APPLY_DIAG", "APPLY_L", "APPLY_U"},
+        "parsec/data_dist/matrix/broadcast.jdf": {"recv", "send"},
+        "examples/Ex01_HelloWorld.jdf": {"HelloWorld"},
+        "examples/Ex04_ChainData.jdf": {"Task"},
+    }
+    for rel, tasks in expected.items():
+        jdf = load_c_jdf(REF / rel)
+        assert set(jdf.tasks) == tasks, rel
+
+
+@needs_ref
+def test_ep_scheduling_benchmark_runs_verbatim():
+    """tests/runtime/scheduling/ep.jdf — the shape behind the
+    reference's dispatch benchmark AND this repo's bench_dispatch_us —
+    ingests and drains verbatim (empty C bodies auto-convert; the
+    multi-line ternary else-branch merges)."""
+    from parsec_tpu.data_dist.collection import DictCollection
+    jdf = load_c_jdf(REF / "tests" / "runtime" / "scheduling" / "ep.jdf")
+    A = DictCollection("A", dtt=TileType((1,), np.float32),
+                       init_fn=lambda *k: np.zeros(1, np.float32))
+    NT, DEPTH = 20, 15
+    done = {"n": 0}
+    tp = jdf.build(A=A, NT=NT, DEPTH=DEPTH)
+    tc = tp.task_class("TASK")
+    orig = tc.complete_execution
+
+    def count(es, task):
+        done["n"] += 1
+        if orig is not None:
+            orig(es, task)
+
+    tc.complete_execution = count
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=60)
+    assert done["n"] == NT * DEPTH
 
 
 @needs_ref
