@@ -1,4 +1,4 @@
-"""The Ex00–Ex09 examples ladder is living documentation: every script
+"""The Ex00–Ex10 examples ladder is living documentation: every script
 must keep running and self-checking (reference examples/ + SURVEY §2.11)."""
 
 import importlib.util
@@ -19,7 +19,7 @@ def load(path):
 
 def test_ladder_is_complete():
     assert [p.stem.split("_")[0] for p in EXAMPLES] == \
-        [f"Ex{i:02d}" for i in range(10)]
+        [f"Ex{i:02d}" for i in range(11)]
 
 
 @pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: p.stem)
