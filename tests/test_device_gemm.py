@@ -175,6 +175,24 @@ class TestVmapBatching:
         batched = self._run(accel_device, False)
         assert batched == 0
 
+    def test_non_power_of_two_batches_pad_correctly(self, accel_device):
+        """A 3x3x3 GEMM's wavefronts are 9 tasks — the fused dispatch
+        pads to 16 lanes with copies of lane 0 and must drop the pad
+        outputs (a pad write leaking into a real tile shows up as wrong
+        numerics)."""
+        rng = np.random.default_rng(6)
+        a, b, c, A, B, C = _mk_abc(48, 48, 48, 16, rng)
+        tp = tiled_gemm_ptg(A, B, C, devices="tpu")
+        ctx = Context(nb_cores=0)
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+        accel_device.sync()
+        ctx.fini()
+        np.testing.assert_allclose(C.to_dense(), c + a @ b, rtol=1e-3,
+                                   atol=1e-4)
+        assert accel_device.batched_dispatches > 0
+        assert accel_device.executed_tasks == 3 * 3 * 3
+
     def test_fused_batch_is_one_xla_call(self, accel_device):
         """The whole batch — on-device stacking, vmapped exec, per-task
         output slicing — rides ONE enqueue (VERDICT r4 item 5: through a
