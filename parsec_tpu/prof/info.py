@@ -9,6 +9,7 @@ when the event infos carry them.
 
     python -m parsec_tpu.prof.info rank0.prof [rank1.prof ...]
     python -m parsec_tpu.prof.info --validate rank*.prof
+    python -m parsec_tpu.prof.info --chrome out.json rank0.prof
 """
 
 from __future__ import annotations
@@ -85,6 +86,14 @@ def summarize(path: str, out=None, validate: bool = False) -> dict:
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     validate = "--validate" in argv
+    chrome = None
+    if "--chrome" in argv:
+        i = argv.index("--chrome")
+        if i + 1 >= len(argv):
+            print(__doc__, file=sys.stderr)
+            return 2
+        chrome = argv[i + 1]
+        del argv[i:i + 2]
     paths = [a for a in argv if a != "--validate"]
     if not paths:
         print(__doc__, file=sys.stderr)
@@ -94,6 +103,11 @@ def main(argv: list[str] | None = None) -> int:
         res = summarize(path, validate=validate)
         if res["problems"]:
             rc = 1
+    if chrome is not None:
+        # one-command standard-viewer conversion (dbp2xml role): the
+        # FIRST trace exports; merge multi-rank views in the viewer
+        Profiling.load(paths[0]).to_chrome_trace(chrome)
+        print(f"chrome trace written: {chrome}", file=sys.stderr)
     return rc
 
 

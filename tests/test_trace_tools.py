@@ -46,6 +46,18 @@ def test_info_cli_summarizes_multirank_traces(trace_dir):
     assert "count" in out and "mean" in out
 
 
+def test_info_chrome_export_flag(trace_dir, tmp_path):
+    import json
+    out = tmp_path / "trace.json"
+    p = subprocess.run(
+        [sys.executable, "-m", "parsec_tpu.prof.info", "--chrome",
+         str(out), str(trace_dir / "rank0.prof")],
+        capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stderr[-1500:]
+    trace = json.loads(out.read_text())
+    assert any(ev.get("ph") == "X" for ev in trace["traceEvents"])
+
+
 def test_info_summarize_returns_stats(trace_dir):
     from parsec_tpu.prof.info import summarize
     import io
