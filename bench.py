@@ -140,6 +140,17 @@ def bench_raw_dot_gflops(n: int = 16384, reps: int = 48) -> dict:
             "reps": reps, "seconds": t}
 
 
+def _scalar_sync(copy) -> float:
+    """Force completion by reading ONE element of a (possibly device)
+    copy — ``jax.block_until_ready`` is a NO-OP through the axon relay,
+    so a timed region closed by ``dev.sync()`` alone would measure
+    enqueue, not completion.  One element = one RTT, not a tile D2H."""
+    import numpy as np
+    v = copy.value
+    ndim = getattr(v, "ndim", 0)
+    return float(np.asarray(v[(0,) * ndim] if ndim else v))
+
+
 def bench_dynamic_gemm_gflops(n: int = 8192, nb: int = 1024) -> dict:
     """The dynamic-runtime path on the real chip: PTG GEMM(m,n,k) executed
     task by task through the TPU device module (stage-in, LRU cache, vmapped
@@ -201,6 +212,8 @@ def bench_dynamic_gemm_gflops(n: int = 8192, nb: int = 1024) -> dict:
         ctx.wait(timeout=120)
         t_drained = time.perf_counter() - t0
         dev.sync()
+        # completion fence the relay can't fake: one-element D2H read
+        _scalar_sync(C.data_of(C.mt - 1, C.nt - 1).newest_copy())
         t = time.perf_counter() - t0
     finally:
         ctx.fini()      # a timed-out drain must not leak the Context +
@@ -266,6 +279,7 @@ def bench_dynamic_cholesky_gflops(n: int = 8192, nb: int = 1024) -> dict:
         ctx.add_taskpool(tp)
         ctx.wait(timeout=120)
         dev.sync()
+        _scalar_sync(A.data_of(A.mt - 1, A.mt - 1).newest_copy())
         t = time.perf_counter() - t0
     finally:
         ctx.fini()
@@ -433,10 +447,11 @@ def bench_dtd_gemm_tpu(n: int = 8192, nb: int = 1024) -> dict:
                                    (C[m][n_], INOUT), tpu_kernel="gemm")
         tp.wait()
         dev.sync()
+        _scalar_sync(tp.tile_of_array(C[0][0]).data.newest_copy())
         t = time.perf_counter() - t0
         # spot-check OUTSIDE the timed section: read the final (device)
-        # version of one C tile — a D2H pull, which through the axon relay
-        # times the tunnel (~70ms RTT/tile), not the framework
+        # version of one C tile — a FULL-tile D2H pull, which through the
+        # axon relay times the tunnel (~70ms RTT/tile), not the framework
         got = np.asarray(tp.tile_of_array(C[0][0]).data.newest_copy().value)
     finally:
         ctx.fini()
