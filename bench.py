@@ -207,6 +207,7 @@ def bench_dynamic_gemm_gflops(n: int = 8192, nb: int = 1024) -> dict:
     tm0 = dev.t_manager
     ctx = Context(nb_cores=0)
     t0 = time.perf_counter()
+    deadline = t0 + 120
     try:
         ctx.add_taskpool(tp)
         ctx.wait(timeout=120)
@@ -216,8 +217,11 @@ def bench_dynamic_gemm_gflops(n: int = 8192, nb: int = 1024) -> dict:
         _scalar_sync(C.data_of(C.mt - 1, C.nt - 1).newest_copy())
         t = time.perf_counter() - t0
     finally:
-        ctx.fini()      # a timed-out drain must not leak the Context +
-        #                 tile set into every later stage on this device
+        # bounded drain reusing this stage's (possibly expired) deadline:
+        # a timed-out wait must not leak the Context + tile set into every
+        # later stage, and fini on a wedged relay must not hang the
+        # cleanup forever either (it stall-dumps and aborts instead)
+        ctx.fini(timeout=max(0.0, deadline - time.perf_counter()))
     calls = dev.xla_calls - calls0
     h2d = dev.bytes_in - bin0
     stage_s = dev.t_stage_in - ts0
@@ -275,6 +279,7 @@ def bench_dynamic_cholesky_gflops(n: int = 8192, nb: int = 1024) -> dict:
     tp = tiled_cholesky_ptg(A, devices="tpu")
     ctx = Context(nb_cores=0)
     t0 = time.perf_counter()
+    deadline = t0 + 120
     try:
         ctx.add_taskpool(tp)
         ctx.wait(timeout=120)
@@ -282,7 +287,7 @@ def bench_dynamic_cholesky_gflops(n: int = 8192, nb: int = 1024) -> dict:
         _scalar_sync(A.data_of(A.mt - 1, A.mt - 1).newest_copy())
         t = time.perf_counter() - t0
     finally:
-        ctx.fini()
+        ctx.fini(timeout=max(0.0, deadline - time.perf_counter()))
     # correctness spot check: || L[0,0] - chol(A)[0,0] tile || small
     got = np.asarray(A.data_of(0, 0).newest_copy().value)
     expect = np.linalg.cholesky(a[:nb, :nb].astype(np.float64))
@@ -436,6 +441,7 @@ def bench_dtd_gemm_tpu(n: int = 8192, nb: int = 1024) -> dict:
 
     ctx = Context(nb_cores=0)
     tp = DTDTaskpool()
+    deadline = time.perf_counter() + 150
     try:
         ctx.add_taskpool(tp)
         t0 = time.perf_counter()
@@ -454,7 +460,7 @@ def bench_dtd_gemm_tpu(n: int = 8192, nb: int = 1024) -> dict:
         # axon relay times the tunnel (~70ms RTT/tile), not the framework
         got = np.asarray(tp.tile_of_array(C[0][0]).data.newest_copy().value)
     finally:
-        ctx.fini()
+        ctx.fini(timeout=max(0.0, deadline - time.perf_counter()))
     want = np.zeros((nb, nb), np.float32)
     for k in range(NT):
         want += A[0][k] @ B[k][0]
@@ -501,6 +507,19 @@ def bench_dispatch_us(ntasks: int = 2000) -> float:
 _abandoned: list = []    # stages whose worker thread outlived its timeout
 
 
+def _runtime_report() -> dict:
+    """The flight-recorder self-measurement embedded in EVERY stage
+    result — degraded ones included, so even a relay outage ships
+    per-stage runtime evidence (the round-5 lesson: a zero with no
+    self-report is indistinguishable from a framework bug).  Must never
+    raise: a broken report is itself reported."""
+    try:
+        from parsec_tpu.prof import runtime_report
+        return runtime_report()
+    except Exception as e:                     # noqa: BLE001 — evidence
+        return {"unavailable": f"{type(e).__name__}: {e}"}
+
+
 def _staged(name, fn, *a, timeout=120.0, retries=1, **kw):
     """Run one bench stage in a worker thread with a HARD join timeout.
 
@@ -524,6 +543,10 @@ def _staged(name, fn, *a, timeout=120.0, retries=1, **kw):
     import sys
     import threading
     t_stage = time.perf_counter()
+    # the degraded-stage taint convention: snapshot the abandoned list
+    # BEFORE this stage can add itself, so no degrade path ever lists the
+    # stage as its own taint (ADVICE round 5: the budget path diverged)
+    prior = list(_abandoned)
     for attempt in range(retries + 1):
         box = {}
 
@@ -535,11 +558,13 @@ def _staged(name, fn, *a, timeout=120.0, retries=1, **kw):
 
         left = timeout - (time.perf_counter() - t_stage)
         if attempt and left <= 1.0:
+            print(f"[bench] {name}: stage budget {timeout:.0f}s exhausted "
+                  f"after {attempt} attempt(s)", file=sys.stderr, flush=True)
             return {"gflops": 0.0,
                     "error": f"stage budget {timeout:.0f}s exhausted "
                              f"after {attempt} attempt(s)",
-                    **({"tainted_by": list(_abandoned)} if _abandoned
-                       else {})}
+                    "runtime_report": _runtime_report(),
+                    **({"tainted_by": prior} if prior else {})}
         th = threading.Thread(target=work, daemon=True, name=f"bench-{name}")
         t0 = time.perf_counter()
         th.start()
@@ -548,24 +573,27 @@ def _staged(name, fn, *a, timeout=120.0, retries=1, **kw):
         if th.is_alive():
             print(f"[bench] {name}: TIMEOUT after {wall:.1f}s — stage "
                   f"thread abandoned", file=sys.stderr, flush=True)
-            prior = list(_abandoned)
             _abandoned.append(name)
             return {"gflops": 0.0,
                     "error": f"stage timeout after {timeout:.0f}s",
+                    "runtime_report": _runtime_report(),
                     **({"tainted_by": prior} if prior else {})}
         if "err" in box:
             e = box["err"]
             print(f"[bench] {name}: attempt {attempt + 1} failed "
                   f"({type(e).__name__}: {e})", file=sys.stderr, flush=True)
             if attempt >= retries:
-                return {"gflops": 0.0, "error": f"{type(e).__name__}: {e}"}
+                return {"gflops": 0.0, "error": f"{type(e).__name__}: {e}",
+                        "runtime_report": _runtime_report()}
             continue
         print(f"[bench] {name}: {wall:.1f}s", file=sys.stderr, flush=True)
         out = box["out"]
-        if _abandoned and isinstance(out, dict):
-            # a zombie stage may still be dispatching on the shared
-            # device: this stage's counters/deltas are suspect
-            out["tainted_by"] = list(_abandoned)
+        if isinstance(out, dict):
+            out.setdefault("runtime_report", _runtime_report())
+            if _abandoned:
+                # a zombie stage may still be dispatching on the shared
+                # device: this stage's counters/deltas are suspect
+                out["tainted_by"] = list(_abandoned)
         return out
 
 
@@ -592,6 +620,11 @@ def main() -> None:
         os.environ["JAX_PLATFORMS"] = os.environ["BENCH_PLATFORM"]
         import jax
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    # observability defaults for the whole run (read when the prof params
+    # register, i.e. on the first parsec_tpu import inside a stage): keep
+    # the metrics snapshotter sampling so every stage's runtime_report
+    # carries a series, and stall dumps land next to the BENCH artifacts
+    os.environ.setdefault("PARSEC_MCA_prof_snapshot_interval", "0.25")
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     if smoke:
         # exercise the dynamic device path on the host CPU device too —
@@ -612,6 +645,12 @@ def main() -> None:
                     for nm, d in res.items()
                     if isinstance(d, dict) and (d.get("error")
                                                 or d.get("skipped"))}
+        # the per-stage runtime self-reports (flight-recorder counters,
+        # per-worker last activity): EVERY stage ships one, degraded
+        # stages included — a relay outage still reads as runtime
+        # evidence, not silence
+        reports = {nm: d["runtime_report"] for nm, d in res.items()
+                   if isinstance(d, dict) and "runtime_report" in d}
         line = json.dumps({
             "metric": "ptg_tiled_gemm_gflops_per_chip",
             "value": round(gemm.get("gflops", 0.0), 1),
@@ -660,6 +699,7 @@ def main() -> None:
                 "lowered_stencil_compile_s": res.get(
                     "lowered_stencil", {}).get("compile_s", 0.0),
                 "elapsed_s": round(time.perf_counter() - t_start, 1),
+                "runtime_reports": reports,
                 **({"degraded_stages": degraded} if degraded else {}),
                 **({"abandoned_stages": list(_abandoned)}
                    if _abandoned else {}),
@@ -677,7 +717,8 @@ def main() -> None:
         if not primary and left < 15.0:
             print(f"[bench] {name}: SKIPPED ({deadline:.0f}s deadline)",
                   file=sys.stderr, flush=True)
-            res[name] = {"gflops": 0.0, "skipped": "deadline exhausted"}
+            res[name] = {"gflops": 0.0, "skipped": "deadline exhausted",
+                         "runtime_report": _runtime_report()}
         else:
             # a primary stage may overshoot the deadline (the headline
             # matters more than the tail) but never unboundedly — its
@@ -713,6 +754,11 @@ def main() -> None:
     # --- primary metrics first: a headline must land within minutes ---
     d = _staged("dispatch", bench_dispatch_us, timeout=90.0)
     res["dispatch_us"] = round(d, 2) if isinstance(d, float) else -1.0
+    # the dispatch stage's self-report rides like every other stage's
+    # (its headline value stays the flat task_dispatch_us key)
+    res["dispatch"] = d if isinstance(d, dict) else \
+        {"dispatch_us": res["dispatch_us"]}
+    res["dispatch"].setdefault("runtime_report", _runtime_report())
     emit()
     stage("gemm", bench_gemm_gflops, timeout=300.0, retries=2,
           primary=True, **cfg["gemm"])

@@ -43,6 +43,8 @@ import numpy as np
 from ..core.params import params as _params
 from ..data.data import data_create
 from ..data.datatype import wire_slice_key
+from ..prof import pins
+from ..prof.pins import PinsEvent
 from ..runtime.scheduling import (ExecutionStream, _find_input_dep,
                                   apply_writeback_to_home, schedule_tasks)
 from ..runtime.task import Task
@@ -212,6 +214,9 @@ class RemoteDepEngine:
         # distributed termdet monitors by taskpool comm-id, + stashed tokens
         self._termdet: dict[int, Any] = {}
         self._pending_termdet: list[dict] = []
+        # received activation payload bytes (the inbound counterpart of
+        # payload_bytes_staged; both are snapshotter-sampled gauges)
+        self.payload_bytes_received = 0
         ce.tag_register(AM_TAG_ACTIVATE, self._on_activate)
         ce.tag_register(AM_TAG_GET_ACK, self._on_ack)
         ce.tag_register(AM_TAG_TERMDET, self._on_termdet)
@@ -220,6 +225,13 @@ class RemoteDepEngine:
         # spin on raw ce.progress() (sync, quiesce) must flush forwards
         # their own AM handlers stage mid-wait
         ce.flush_hook = self.flush_outgoing
+        from ..prof.counters import sde
+        sde.register_gauge(f"comm::rank{self.my_rank}::inflight",
+                           self.inflight)
+        sde.register_gauge(f"comm::rank{self.my_rank}::bytes_out",
+                           lambda: self.payload_bytes_staged)
+        sde.register_gauge(f"comm::rank{self.my_rank}::bytes_in",
+                           lambda: self.payload_bytes_received)
 
     # ------------------------------------------------------------ lifecycle
     def enable(self) -> None:
@@ -257,6 +269,27 @@ class RemoteDepEngine:
             self._comm_thread = None
         self.flush_outgoing()
         self.ce.fini()
+        from ..prof.counters import sde
+        for g in ("inflight", "bytes_out", "bytes_in"):
+            sde.unregister_gauge(f"comm::rank{self.my_rank}::{g}")
+
+    def debug_state(self) -> dict:
+        """In-flight comm operations for the flight-recorder stall dump."""
+        with self._outq_lock:
+            staged = {dst: len(items) for dst, items in self._outq.items()}
+        with self._iflock:
+            inflight = len(self._inflight)
+        with self._pending_lock:
+            unknown = len(self._pending_unknown_tp)
+            pending_td = len(self._pending_termdet)
+        return {"rank": self.my_rank, "inflight_activations": inflight,
+                "staged_sends": staged, "pending_unknown_taskpool": unknown,
+                "pending_termdet_tokens": pending_td,
+                "dup_acks": self.dup_acks,
+                "payload_bytes_staged": self.payload_bytes_staged,
+                "payload_bytes_received": self.payload_bytes_received,
+                "engine_pending": self.ce.pending(),
+                "comm_thread": self._comm_thread is not None}
 
     def progress(self, es: Any = None) -> int:
         # the engine's progress drives flush_outgoing through flush_hook,
@@ -437,9 +470,12 @@ class RemoteDepEngine:
             child_msg = dict(msg)
             child_msg["seq"] = seq
             child_msg["pos"] = child_pos
+            pins.fire(PinsEvent.COMM_ACTIVATE_SEND, None,
+                      (ranks[child_pos], seq))
             self._post_activate(ranks[child_pos], child_msg)
 
     def _on_ack(self, eng, src: int, msg: dict) -> None:
+        pins.fire(PinsEvent.COMM_ACK_RECV, None, int(msg["seq"]))
         with self._iflock:
             tp = self._inflight.pop(msg["seq"], None)
         if tp is None:
@@ -536,6 +572,7 @@ class RemoteDepEngine:
         tp = self._lookup_or_pend(self._on_activate, src, msg)
         if tp is None:
             return
+        pins.fire(PinsEvent.ACTIVATE_CB_BEGIN, None, (src, msg["seq"]))
         want = [d for d in msg["outputs"] if "wire" in d]
         # every receiver owns its bytes: an inline payload forwarded down the
         # tree would otherwise alias across ranks
@@ -564,6 +601,8 @@ class RemoteDepEngine:
                            landed: dict[int, Any]) -> None:
         """All payloads present: release local successors, apply writebacks,
         forward down the tree, ack the parent."""
+        for v in landed.values():
+            self.payload_bytes_received += int(getattr(v, "nbytes", 0))
         tp.tdm.on_comm_recv()
         tc = tp.task_classes[msg["tc"]]
         ghost = Task(tp, tc, dict(msg["locals"]),
@@ -645,6 +684,7 @@ class RemoteDepEngine:
             self._flush_if_unthreaded()
 
         self.ce.send_am(AM_TAG_GET_ACK, src, {"seq": msg["seq"]})
+        pins.fire(PinsEvent.ACTIVATE_CB_END, None, (src, msg["seq"]))
         if ready:
             schedule_tasks(self._es, ready, 0)
 

@@ -35,6 +35,8 @@ from typing import Any, Callable
 from ..core.params import params as _params
 from ..data.data import (COHERENCY_EXCLUSIVE, COHERENCY_INVALID,
                          COHERENCY_OWNED, COHERENCY_SHARED, DataCopy)
+from ..prof import pins
+from ..prof.pins import PinsEvent
 from ..runtime.task import (HOOK_RETURN_ASYNC, HOOK_RETURN_DONE)
 from .device import Device, registry
 
@@ -122,6 +124,34 @@ class TPUDevice(Device):
         self.t_complete = 0.0
         self.t_drain = 0.0
         self.t_manager = 0.0   # total wall inside the manager drain loop
+        # stage-in tile-cache effectiveness, per (task, flow) reference —
+        # the hit-rate gauge the metrics snapshotter samples
+        self.cache_hits = 0
+        self.cache_misses = 0
+        # gauges hold the device only WEAKLY: devices are never fini'd,
+        # and a strong closure would keep a discarded device (test
+        # fixtures, demoted devices) plus its LRU tile cache alive in
+        # the process-global SDE registry forever
+        import weakref
+        from ..prof.counters import sde
+        ref = weakref.ref(self)
+
+        def gauge(fn):
+            def get():
+                d = ref()
+                return fn(d) if d is not None else 0
+            return get
+
+        sde.register_gauge(
+            f"device::{self.name}::stage_in_hit_rate",
+            gauge(lambda d: d.cache_hits
+                  / max(1, d.cache_hits + d.cache_misses)))
+        sde.register_gauge(f"device::{self.name}::bytes_in",
+                           gauge(lambda d: d.bytes_in))
+        sde.register_gauge(f"device::{self.name}::bytes_out",
+                           gauge(lambda d: d.bytes_out))
+        sde.register_gauge(f"device::{self.name}::pending",
+                           gauge(lambda d: len(d._pending)))
 
     # ------------------------------------------------------------- memory
     def _hbm_budget(self) -> int:
@@ -194,6 +224,8 @@ class TPUDevice(Device):
                         pass    # transfer falls back to the sync read below
                 victims.append(c)
         i = 0
+        if victims:
+            pins.fire(PinsEvent.DEVICE_EVICT, None, len(victims))
         try:
             while i < len(victims):
                 self._writeback(victims[i])
@@ -267,13 +299,26 @@ class TPUDevice(Device):
                 if dev_copy is not None \
                         and dev_copy.version >= copy.version \
                         and dev_copy.coherency != COHERENCY_INVALID:
+                    self.cache_hits += 1
                     task.data[f.flow_index] = dev_copy
                     self._cache_insert(d.key, dev_copy,
                                        _copy_nbytes(dev_copy))
                     continue
+                self.cache_misses += 1
                 prev = missing.get(d.key)
-                if prev is None or copy.version > prev.version:
+                if prev is None:
                     missing[d.key] = copy
+                elif copy.version != prev.version:
+                    # two tasks in one batch reference DIFFERENT versions
+                    # of the same datum: dedupe keeps the highest, and the
+                    # flight recorder makes that observable (ADVICE r5 —
+                    # a copy-renaming scheme added later must not be able
+                    # to silently hand an old-version reader new data)
+                    pins.fire(PinsEvent.DEVICE_STAGE_MIXED_VERSIONS, None,
+                              (d.key, max(copy.version, prev.version),
+                               min(copy.version, prev.version)))
+                    if copy.version > prev.version:
+                        missing[d.key] = copy
                 assigns.append((task, f.flow_index, d.key))
         if not missing:
             return
@@ -281,6 +326,7 @@ class TPUDevice(Device):
         values = jax.device_put([missing[k].value for k in keys],
                                 self.jax_device)
         landed: dict[Any, DataCopy] = {}
+        batch_nb = 0
         for k, value in zip(keys, values):
             src = missing[k]
             d = src.original
@@ -295,8 +341,10 @@ class TPUDevice(Device):
             dev_copy.coherency = COHERENCY_SHARED
             nb = getattr(src.value, "nbytes", 0)
             self.bytes_in += nb
+            batch_nb += nb
             self._cache_insert(d.key, dev_copy, nb)
             landed[k] = dev_copy
+        pins.fire(PinsEvent.DEVICE_STAGE_IN, None, int(batch_nb))
         for task, fi, k in assigns:
             # every assigned key was ensured in `missing` and every miss
             # lands above — a KeyError here is a real landing bug
@@ -308,6 +356,7 @@ class TPUDevice(Device):
         becomes the manager and drains the device (device_gpu.c:2457-2473)."""
         import time as _time
         dtask = TPUDeviceTask(es, task, submit)
+        pins.fire(PinsEvent.DEVICE_ENQUEUE, es, task)
         with self._mutex_lock:
             self._pending.append(dtask)
             if self._managing:
@@ -490,6 +539,7 @@ class TPUDevice(Device):
     def _run_batch(self, batch: list[TPUDeviceTask]) -> None:
         import time as _time
         from ..runtime.scheduling import complete_execution
+        pins.fire(PinsEvent.DEVICE_BATCH_BEGIN, None, len(batch))
         t0 = _time.perf_counter()
         # stage-in phase (stream 0 analog): user-hooked tasks stage
         # individually, everything else moves in one batched device_put
@@ -516,6 +566,7 @@ class TPUDevice(Device):
                 dtask.stage_out(self, dtask.task)
             complete_execution(dtask.es, dtask.task)
         self.t_complete += _time.perf_counter() - t2
+        pins.fire(PinsEvent.DEVICE_BATCH_END, None, len(batch))
 
     def _mark_written(self, task: Any) -> None:
         # written flows become dirty device copies (coherency epilog,
@@ -649,6 +700,42 @@ class TPUDevice(Device):
     def sync(self) -> None:
         while self._inflight:
             self._confirm(self._inflight.popleft())
+
+    # -------------------------------------------------------- diagnostics
+    def debug_state(self) -> dict:
+        """Stage-in / pipeline state for the flight-recorder stall dump.
+        Lock acquisition is bounded: a dump racing a wedged manager must
+        report what it can reach, never block."""
+        state = {"name": self.name, "enabled": self.enabled,
+                 "executed_tasks": self.executed_tasks,
+                 "xla_calls": self.xla_calls,
+                 "batched_dispatches": self.batched_dispatches,
+                 "inflight_dispatches": len(self._inflight),
+                 "cache_hits": self.cache_hits,
+                 "cache_misses": self.cache_misses,
+                 "bytes_in": self.bytes_in, "bytes_out": self.bytes_out,
+                 "stage_in_s": round(self.t_stage_in, 3),
+                 "dispatch_s": round(self.t_dispatch, 3),
+                 "complete_s": round(self.t_complete, 3),
+                 "drain_s": round(self.t_drain, 3)}
+        if self._mutex_lock.acquire(timeout=0.2):
+            try:
+                state["pending_tasks"] = len(self._pending)
+                state["managing"] = self._managing
+            finally:
+                self._mutex_lock.release()
+        else:
+            state["pending_tasks"] = "<manager lock held>"
+        if self._lru_lock.acquire(timeout=0.2):
+            try:
+                state["lru_tiles"] = len(self._mem_lru)
+                state["lru_bytes"] = self._mem_bytes
+                state["evict_queue"] = len(self._evict_q)
+            finally:
+                self._lru_lock.release()
+        else:
+            state["lru_tiles"] = "<lru lock held>"
+        return state
 
 
 def _flop_rating(kind: str) -> tuple[float, float]:

@@ -4,7 +4,10 @@
 - :mod:`profiling` — dictionary-keyed binary traces + pandas converter;
 - :mod:`task_profiler` — the PINS→trace bridge module;
 - :mod:`grapher` — executed-DAG DOT output;
-- :mod:`counters` — SDE-style counters + the live properties dictionary.
+- :mod:`counters` — SDE-style counters + the live properties dictionary;
+- :mod:`flight_recorder` — the always-on per-worker event rings, stall
+  dump, metrics snapshotter, and the unified run-report export
+  (:func:`export_run_report` / :func:`runtime_report`).
 """
 
 from . import pins
@@ -14,6 +17,8 @@ from .profiling import profiling as trace_state   # the global instance —
 # exported under a distinct name so it cannot shadow the submodule
 # ``parsec_tpu.prof.profiling`` on the package object
 from .counters import properties, sde
+from . import flight_recorder
+from .flight_recorder import export_run_report, runtime_report
 from . import task_profiler as _task_profiler   # register components
 from . import grapher as _grapher               # register components
 from . import debug_marks as _debug_marks       # register components
@@ -21,4 +26,4 @@ from . import iterators_checker as _iterchk     # register components
 from . import perf_modules as _perf_modules     # register components
 
 __all__ = ["PinsEvent", "pins", "Profiling", "trace_state", "properties",
-           "sde"]
+           "sde", "flight_recorder", "export_run_report", "runtime_report"]

@@ -74,6 +74,12 @@ def merge(paths: list[str]) -> tuple[dict, dict]:
     return nodes, edges
 
 
+def _esc(v: str) -> str:
+    """DOT double-quoted string escaping: a label containing ``"`` must
+    not terminate the attribute value (ADVICE round 5)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
 def write_merged(paths: list[str], out_path: str,
                  name: str = "merged") -> dict:
     nodes, edges = merge(paths)
@@ -81,17 +87,21 @@ def write_merged(paths: list[str], out_path: str,
     with open(out_path, "w") as f:
         f.write(f"digraph {name} {{\n")
         for nid, attrs in nodes.items():
-            alist = " ".join(f'{k}="{v}"' for k, v in attrs.items())
-            f.write(f'  "{nid}" [{alist}];\n')
+            alist = " ".join(f'{k}="{_esc(v)}"' for k, v in attrs.items())
+            f.write(f'  "{_esc(nid)}" [{alist}];\n')
         for (src, dst, _label), attrs in edges.items():
             sr = nodes.get(src, {}).get("ranks")
             dr = nodes.get(dst, {}).get("ranks")
-            if sr is not None and dr is not None and sr != dr:
-                # a remote dep: endpoints executed on different ranks
+            # rank SETS, not joined strings: a node replicated on several
+            # ranks (ranks="0,1") shares a rank with its peer whenever the
+            # intersection is non-empty — only a truly disjoint pair is a
+            # remote dep (ADVICE round 5)
+            if sr is not None and dr is not None \
+                    and not (set(sr.split(",")) & set(dr.split(","))):
                 attrs = dict(attrs, style="dashed")
                 cross += 1
-            alist = " ".join(f'{k}="{v}"' for k, v in attrs.items())
-            f.write(f'  "{src}" -> "{dst}" [{alist}];\n')
+            alist = " ".join(f'{k}="{_esc(v)}"' for k, v in attrs.items())
+            f.write(f'  "{_esc(src)}" -> "{_esc(dst)}" [{alist}];\n')
         f.write("}\n")
     return {"nodes": len(nodes), "edges": len(edges),
             "cross_rank_edges": cross}

@@ -1,0 +1,522 @@
+"""The always-on runtime flight recorder + unified metrics layer.
+
+Role: the evidence pipeline PaRSEC builds from its PINS instrumentation
+bus and binary profiling streams (``parsec/mca/pins/pins.h``, SURVEY
+§layer map) — wired, unlike the reference, to be ON by default and to
+survive a wedged run:
+
+- **Flight recorder** — every :func:`pins.fire` site feeds a per-worker
+  fixed-size ring of ``(event, timestamp_ns, task_id, payload_summary)``
+  records through ``pins.recorder``.  Enabled cost per site is one branch
+  plus one ring write; disabled cost is one attribute load + truth test
+  (the compiled-out analog).  Rings are thread-local, so no site ever
+  takes a lock.
+- **Stall dump** — :func:`stall_dump` serializes every worker's last-N
+  events, scheduler queue depths, in-flight comm operations, and device
+  stage-in state to stderr and a ``flightrec-<rank>.json`` artifact.
+  ``Context.wait()`` fires it on a :class:`ContextWaitTimeout
+  <parsec_tpu.runtime.context.ContextWaitTimeout>` and ``Context.fini()``
+  on a bounded drain that cannot complete — a hung relay produces a
+  diagnosis instead of silence (the round-5 zero-evidence failure mode).
+- **Metrics snapshotter** — a thread sampling :data:`SdeCounters
+  <parsec_tpu.prof.counters.sde>` and the live properties dictionary on
+  ``prof_snapshot_interval`` into a bounded in-memory series.
+- **Unified export** — :func:`export_run_report` merges ring events,
+  the counter series, and the binary :mod:`profiling
+  <parsec_tpu.prof.profiling>` streams into one Chrome trace + JSON
+  summary; :func:`runtime_report` is the compact per-stage block
+  ``bench.py`` embeds in every ``BENCH_*.json`` stage.
+
+See ``docs/OBSERVABILITY.md`` for the operator-facing guide.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any
+
+from ..core.params import params as _params
+from . import pins
+from .pins import PinsEvent
+
+_params.register("prof_flightrec_size", 256,
+                 "per-worker flight-recorder ring capacity "
+                 "(events kept per thread; 0 disables the recorder)")
+_params.register("prof_flightrec_dir", ".",
+                 "directory stall-dump artifacts (flightrec-<rank>.json) "
+                 "are written to; empty = stderr only")
+_params.register("prof_stall_dump", True,
+                 "dump flight-recorder state to stderr + artifact when a "
+                 "Context.wait()/fini() drain times out")
+_params.register("prof_snapshot_interval", 0.0,
+                 "seconds between periodic metrics snapshots "
+                 "(SDE counters + live properties; 0 disables the thread)")
+
+_now = time.perf_counter_ns
+_N_EVENTS = max(int(e) for e in PinsEvent) + 1
+_SB, _SE = PinsEvent.SELECT_BEGIN, PinsEvent.SELECT_END
+_DFB, _DFE = PinsEvent.DAG_FETCH_BEGIN, PinsEvent.DAG_FETCH_END
+
+
+def _describe(p: Any) -> tuple[Any, Any]:
+    """Cheap (task_id, payload_summary) extraction — no str() of live
+    runtime objects on the hot path beyond small constant work."""
+    if p is None:
+        return None, None
+    # a Task carries task_class (a TaskClass, which has .name); beware
+    # Taskpool.task_class, which is a METHOD — hence the two-step probe
+    tc = getattr(p, "task_class", None)
+    tc_name = getattr(tc, "name", None) if tc is not None else None
+    if tc_name is not None:
+        return getattr(p, "uid", None), tc_name
+    if type(p) is int or type(p) is float:
+        return None, p
+    if type(p) is list:
+        return None, f"list[{len(p)}]"
+    if type(p) is tuple:
+        t0 = p[0] if p else None
+        nm = getattr(getattr(t0, "task_class", None), "name", None)
+        if nm is not None:
+            return getattr(t0, "uid", None), f"{nm}{p[1:]!r}"
+        return None, repr(p)[:80]
+    name = getattr(p, "name", None)
+    return None, (f"{type(p).__name__}({name})" if name
+                  else type(p).__name__)
+
+
+class _Ring:
+    """One worker's fixed-size event ring.  Appends are single-writer
+    (thread-local); snapshots from other threads are best-effort reads."""
+
+    __slots__ = ("name", "size", "slots", "total", "counts", "vsums",
+                 "idle", "idle_ns")
+
+    def __init__(self, name: str, size: int) -> None:
+        self.name = name
+        self.size = size
+        self.slots: list = [None] * size
+        self.total = 0
+        # per-event-type tallies survive ring wraparound: the self-
+        # measurement the run report is built from
+        self.counts = [0] * _N_EVENTS
+        self.vsums = [0] * _N_EVENTS    # sum of integer payloads
+        self.idle = 0                   # empty selects (liveness ticks)
+        self.idle_ns = 0
+
+    def events(self, last: int | None = None) -> list[dict]:
+        n = min(self.total, self.size)
+        start = self.total - n
+        if last is not None and n > last:
+            start = self.total - last
+        out = []
+        for i in range(start, self.total):
+            rec = self.slots[i % self.size]
+            if rec is None:
+                continue        # racing writer; skip the torn slot
+            ev, ts, tid, summ = rec
+            out.append({"event": getattr(ev, "name", str(ev)),
+                        "ts_ns": ts, "task": tid, "info": summ})
+        return out
+
+
+class FlightRecorder:
+    """Process-global recorder: one ring per thread, registry by thread
+    name (the latest thread under a recycled worker name wins, which
+    bounds memory across many short-lived contexts)."""
+
+    def __init__(self, size: int) -> None:
+        self.size = size
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        self.rings: dict[str, _Ring] = {}
+        # tallies folded in from rings displaced by a recycled thread
+        # name: aggregate() stays truly cumulative (a later context's
+        # parsec-es0 must not erase the earlier one's retired count —
+        # that would make runtime_report regress and rates() go negative)
+        self._retired_counts = [0] * _N_EVENTS
+        self._retired_vsums = [0] * _N_EVENTS
+        self._retired_idle = 0
+
+    def _new_ring(self) -> _Ring:
+        r = _Ring(threading.current_thread().name, self.size)
+        with self._lock:
+            old = self.rings.get(r.name)
+            if old is not None:
+                for i in range(_N_EVENTS):
+                    self._retired_counts[i] += old.counts[i]
+                    self._retired_vsums[i] += old.vsums[i]
+                self._retired_idle += old.idle
+            self.rings[r.name] = r
+        self._tls.ring = r
+        return r
+
+    def note(self, event: Any, payload: Any) -> None:
+        """The ``pins.recorder`` hook: one branch + one ring write."""
+        try:
+            r = self._tls.ring
+        except AttributeError:
+            r = self._new_ring()
+        if payload is None:
+            if event is _SE:
+                # an EMPTY select (SELECT_END with no task) would rotate
+                # real history out of the ring; keep it as a liveness
+                # tick instead — an idle-polling worker reads as idle,
+                # not as a wall of SELECTs.  SELECT_BEGIN carries no
+                # payload even on productive selects, so it is skipped
+                # outright rather than miscounted as idleness.
+                r.idle += 1
+                r.idle_ns = _now()
+                return
+            if event is _SB or event is _DFB:
+                return        # info-free begins: the END record suffices
+        elif event is _DFE and payload == 0:
+            # an empty compiled-DAG fetch: the AGAIN-spin analog of an
+            # empty select — liveness tick, not ring spam (a wedged DAG
+            # must not flush its own pre-stall history)
+            r.idle += 1
+            r.idle_ns = _now()
+            return
+        r.counts[event] += 1
+        if type(payload) is int:
+            r.vsums[event] += payload
+        i = r.total
+        tid, summ = _describe(payload)
+        r.slots[i % r.size] = (event, _now(), tid, summ)
+        r.total = i + 1
+
+    def all_rings(self) -> list[_Ring]:
+        with self._lock:
+            return list(self.rings.values())
+
+    def snapshot(self, last: int | None = None) -> dict[str, dict]:
+        """Per-worker ring contents, oldest-first (best-effort under
+        concurrent appends)."""
+        out = {}
+        now = _now()
+        for r in self.all_rings():
+            out[r.name] = {
+                "total": r.total,
+                "idle_selects": r.idle,
+                "idle_age_ms": (round((now - r.idle_ns) / 1e6, 1)
+                                if r.idle else None),
+                "events": r.events(last),
+            }
+        return out
+
+    def aggregate(self) -> tuple[list[int], list[int]]:
+        with self._lock:
+            counts = list(self._retired_counts)
+            vsums = list(self._retired_vsums)
+        for r in self.all_rings():
+            for i, c in enumerate(r.counts):
+                counts[i] += c
+            for i, v in enumerate(r.vsums):
+                vsums[i] += v
+        return counts, vsums
+
+
+recorder: FlightRecorder | None = None
+
+
+def install(size: int | None = None) -> FlightRecorder:
+    """(Re)install the recorder as the PINS fire hook."""
+    global recorder
+    if size is None:
+        size = _params.get("prof_flightrec_size")
+    recorder = FlightRecorder(max(int(size), 1))
+    pins.recorder = recorder.note
+    return recorder
+
+
+def uninstall() -> None:
+    global recorder
+    pins.recorder = None
+    recorder = None
+
+
+def ensure_installed() -> FlightRecorder | None:
+    """Idempotent always-on entry point (every Context calls this):
+    installs the recorder unless ``prof_flightrec_size`` is 0."""
+    if recorder is None and _params.get("prof_flightrec_size") > 0:
+        install()
+    return recorder
+
+
+# ---------------------------------------------------------------------------
+# periodic metrics snapshotter
+# ---------------------------------------------------------------------------
+
+class MetricsSnapshotter:
+    """Samples SDE counters + the live properties dictionary on an
+    interval into a bounded in-memory series.  Refcounted: the thread
+    runs while any context holds a start(); contexts release on
+    teardown."""
+
+    MAX_SAMPLES = 2048
+
+    def __init__(self) -> None:
+        self.series: list[dict] = []
+        self._lock = threading.Lock()
+        self._stop: threading.Event | None = None
+        self._users = 0
+
+    def sample(self) -> dict:
+        from .counters import properties, sde
+        s: dict[str, Any] = {"ts": time.time(), "t_ns": _now(),
+                             "sde": sde.snapshot(), "props": {}}
+        for ns, d in properties.snapshot().items():
+            s["props"][ns] = {k: v for k, v in d.items() if k != "sde"}
+        if recorder is not None:
+            counts, vsums = recorder.aggregate()
+            s["tasks_retired"] = (counts[PinsEvent.COMPLETE_EXEC_END]
+                                  + vsums[PinsEvent.DAG_COMPLETE_END])
+        with self._lock:
+            self.series.append(s)
+            if len(self.series) > self.MAX_SAMPLES:
+                # keep the tail: recent history matters most for a stall
+                del self.series[:self.MAX_SAMPLES // 2]
+        return s
+
+    def start(self, interval: float) -> None:
+        with self._lock:
+            self._users += 1
+            if self._stop is not None:
+                return
+            stop = threading.Event()
+            self._stop = stop
+
+        def run() -> None:
+            while not stop.wait(interval):
+                try:
+                    self.sample()
+                except Exception:
+                    pass        # sampling must never kill a run
+
+        threading.Thread(target=run, daemon=True,
+                         name="parsec-prof-snap").start()
+
+    def release(self) -> None:
+        with self._lock:
+            self._users -= 1
+            if self._users <= 0 and self._stop is not None:
+                self._stop.set()
+                self._stop = None
+                self._users = 0
+
+    def rates(self) -> list[dict]:
+        """tasks-retired/sec derived from consecutive samples."""
+        with self._lock:
+            series = list(self.series)
+        out = []
+        for a, b in zip(series, series[1:]):
+            if "tasks_retired" not in a or "tasks_retired" not in b:
+                continue
+            dt = (b["t_ns"] - a["t_ns"]) / 1e9
+            if dt <= 0:
+                continue
+            out.append({"ts": b["ts"],
+                        "tasks_per_s": round(
+                            (b["tasks_retired"] - a["tasks_retired"]) / dt,
+                            2)})
+        return out
+
+
+snapshotter = MetricsSnapshotter()
+
+
+# ---------------------------------------------------------------------------
+# stall dump
+# ---------------------------------------------------------------------------
+
+def _best_effort(fn, default=None):
+    try:
+        return fn()
+    except Exception as e:                       # noqa: BLE001 — evidence
+        return {"error": f"{type(e).__name__}: {e}"} \
+            if default is None else default
+
+
+def build_stall_report(context: Any = None, reason: str = "",
+                       last: int = 32) -> dict:
+    """Gather the full diagnosis snapshot.  Every section is best-effort:
+    a wedged runtime must still yield whatever evidence is reachable."""
+    from .counters import properties, sde
+    report: dict[str, Any] = {
+        "reason": reason,
+        "ts": time.time(),
+        "rank": getattr(context, "my_rank", 0) if context is not None else 0,
+        "workers": _best_effort(
+            lambda: recorder.snapshot(last) if recorder is not None
+            else {"flightrec": "disabled"}),
+        "sde": _best_effort(sde.snapshot),
+        "props": _best_effort(properties.snapshot),
+        "snapshots": len(snapshotter.series),
+    }
+    if context is not None:
+        report["sched_pending"] = _best_effort(
+            lambda: context.scheduler.pending_tasks(context))
+        report["queue_depths"] = _best_effort(
+            lambda: context.scheduler.queue_depths(context))
+        report["active_taskpools"] = _best_effort(lambda: [
+            {"name": tp.name,
+             "nb_tasks": tp.tdm.nb_tasks if tp.tdm is not None else None,
+             "compiled_dag": getattr(tp, "_compiled_dag", None) is not None}
+            for tp in list(context._active_taskpools)])
+        ce = context.comm_engine
+        if ce is not None and hasattr(ce, "debug_state"):
+            report["comm"] = _best_effort(ce.debug_state)
+
+    def devices():
+        from ..device.device import registry
+        return [d.debug_state() for d in registry.devices
+                if hasattr(d, "debug_state")]
+    report["devices"] = _best_effort(devices, default=[])
+    return report
+
+
+def stall_dump(context: Any = None, reason: str = "", last: int = 32,
+               file: Any = None) -> dict:
+    """Serialize the stall report to stderr (compact) and to the
+    ``flightrec-<rank>.json`` artifact.  Returns the report dict."""
+    report = build_stall_report(context, reason, last)
+    out = file or sys.stderr
+    w = out.write
+    w(f"[flightrec] STALL DUMP rank {report['rank']}: {reason}\n")
+    workers = report.get("workers") or {}
+    if isinstance(workers, dict):
+        now = _now()
+        for name, r in sorted(workers.items()):
+            if not isinstance(r, dict) or "events" not in r:
+                continue
+            evs = r["events"]
+            if evs:
+                e = evs[-1]
+                age = (now - e["ts_ns"]) / 1e6
+                lastline = (f"last={e['event']} task={e['task']} "
+                            f"info={e['info']} {age:.0f}ms ago")
+            else:
+                lastline = "no events"
+            w(f"[flightrec]   {name}: {r['total']} events, "
+              f"{r['idle_selects']} idle selects, {lastline}\n")
+    w(f"[flightrec]   sched_pending={report.get('sched_pending')} "
+      f"queue_depths={report.get('queue_depths')}\n")
+    w(f"[flightrec]   taskpools={report.get('active_taskpools')}\n")
+    if "comm" in report:
+        w(f"[flightrec]   comm={report['comm']}\n")
+    for d in report.get("devices") or []:
+        w(f"[flightrec]   device={d}\n")
+    path = None
+    dirname = _params.get("prof_flightrec_dir")
+    if dirname:
+        path = os.path.join(dirname, f"flightrec-{report['rank']}.json")
+        try:
+            with open(path, "w") as f:
+                json.dump(report, f, default=str)
+            w(f"[flightrec]   artifact: {path}\n")
+        except OSError as e:
+            w(f"[flightrec]   artifact write failed: {e}\n")
+    try:
+        out.flush()
+    except Exception:
+        pass
+    return report
+
+
+# ---------------------------------------------------------------------------
+# unified export
+# ---------------------------------------------------------------------------
+
+def runtime_report(max_workers: int = 6) -> dict:
+    """Compact runtime self-measurement (cumulative since process start):
+    the block ``bench.py`` embeds in every stage of ``BENCH_*.json``.
+
+    ``tasks_retired`` is the TOTAL (dynamic + compiled-DAG), matching the
+    snapshotter's counter track so the two halves of one run report can
+    never contradict each other; the per-path components ride alongside.
+    """
+    rep: dict[str, Any] = {"tasks_retired": 0, "dynamic_tasks_retired": 0,
+                           "dag_tasks_completed": 0,
+                           "h2d_bytes": 0, "comm_activations_sent": 0,
+                           "snapshots": len(snapshotter.series),
+                           "workers": {}}
+    r = recorder
+    if r is None:
+        rep["flightrec"] = "disabled"
+        return rep
+    counts, vsums = r.aggregate()
+    rep["dynamic_tasks_retired"] = counts[PinsEvent.COMPLETE_EXEC_END]
+    rep["dag_tasks_completed"] = vsums[PinsEvent.DAG_COMPLETE_END]
+    rep["tasks_retired"] = (rep["dynamic_tasks_retired"]
+                            + rep["dag_tasks_completed"])
+    rep["h2d_bytes"] = vsums[PinsEvent.DEVICE_STAGE_IN]
+    rep["comm_activations_sent"] = counts[PinsEvent.COMM_ACTIVATE_SEND]
+    now = _now()
+
+    def activity(ring: _Ring) -> int:
+        rec = ring.slots[(ring.total - 1) % ring.size] if ring.total else None
+        return max(rec[1] if rec is not None else 0, ring.idle_ns)
+
+    rings = sorted(r.all_rings(), key=activity, reverse=True)
+    for ring in rings[:max_workers]:
+        evs = ring.events(1)
+        last = evs[-1] if evs else None
+        rep["workers"][ring.name] = {
+            "n": ring.total,
+            "idle": ring.idle,
+            "last": last["event"] if last else None,
+            "age_ms": (round((now - last["ts_ns"]) / 1e6, 1)
+                       if last else None),
+        }
+    return rep
+
+
+def export_run_report(chrome_path: str | None = None) -> dict:
+    """Merge the flight-recorder rings, the metrics snapshot series, and
+    the binary :mod:`profiling` streams into ONE Chrome trace plus a JSON
+    summary — the single artifact a perf PR attaches as its evidence.
+
+    Returns ``{"chrome_trace": <trace-event dict>, "summary": <dict>}``;
+    writes the trace JSON to ``chrome_path`` when given.  Profiling
+    streams ride as pid 0 (exactly :meth:`Profiling.to_chrome_trace`),
+    flight-recorder rings as instant events under pid 1, counter series
+    as ``ph: "C"`` counter tracks under pid 2 — all on the shared
+    ``perf_counter_ns`` clock, so spans and ring events line up.
+    """
+    from .profiling import profiling
+    trace = profiling.to_chrome_trace()
+    events = trace["traceEvents"]
+    rings = recorder.snapshot() if recorder is not None else {}
+    for tid, (name, r) in enumerate(sorted(rings.items())):
+        events.append({"name": "thread_name", "ph": "M", "pid": 1,
+                       "tid": tid, "args": {"name": f"flightrec:{name}"}})
+        for ev in r["events"]:
+            events.append({"name": ev["event"], "cat": "flightrec",
+                           "ph": "i", "s": "t", "ts": ev["ts_ns"] / 1e3,
+                           "pid": 1, "tid": tid,
+                           "args": {"task": ev["task"],
+                                    "info": str(ev["info"])}})
+    with snapshotter._lock:
+        series = list(snapshotter.series)
+    for s in series:
+        ts = s["t_ns"] / 1e3
+        if "tasks_retired" in s:
+            events.append({"name": "tasks_retired", "ph": "C", "ts": ts,
+                           "pid": 2,
+                           "args": {"value": s["tasks_retired"]}})
+        for ns, props in s.get("props", {}).items():
+            v = props.get("sched_pending")
+            if isinstance(v, (int, float)):
+                events.append({"name": f"{ns}::sched_pending", "ph": "C",
+                               "ts": ts, "pid": 2, "args": {"value": v}})
+    summary = runtime_report()
+    summary["profiling_streams"] = len(profiling.streams)
+    summary["trace_events"] = len(events)
+    summary["tasks_per_s"] = snapshotter.rates()[-3:]
+    if chrome_path is not None:
+        with open(chrome_path, "w") as f:
+            json.dump(trace, f, default=str)
+    return {"chrome_trace": trace, "summary": summary}

@@ -42,6 +42,16 @@ class PinsEvent(IntEnum):
     # a select that pulled work from beyond the stream's own queue
     # (payload: (task, distance)) — feeds the print_steals module
     SELECT_STEAL = 22
+    # device-module sites (device/tpu.py) — primarily flight-recorder feed
+    DEVICE_ENQUEUE = 23            # payload: task handed to the manager
+    DEVICE_BATCH_BEGIN = 24        # payload: batch size
+    DEVICE_BATCH_END = 25          # payload: batch size
+    DEVICE_STAGE_IN = 26           # payload: H2D bytes of one batched put
+    DEVICE_EVICT = 27              # payload: victims written back in a drain
+    DEVICE_STAGE_MIXED_VERSIONS = 28   # payload: (key, kept_ver, other_ver)
+    # comm sites (comm/remote_dep.py)
+    COMM_ACTIVATE_SEND = 29        # payload: (dst_rank, seq)
+    COMM_ACK_RECV = 30             # payload: seq
 
 
 Callback = Callable[[Any, Any], None]   # (execution_stream_or_none, payload)
@@ -49,6 +59,13 @@ Callback = Callable[[Any, Any], None]   # (execution_stream_or_none, payload)
 _lock = threading.Lock()
 _chains: dict[int, list[Callback]] = {}
 enabled = False
+
+# the flight-recorder hook (prof/flight_recorder.py): a callable
+# ``(event, payload) -> None`` or None.  Kept separate from the callback
+# chains so the always-on recorder costs one list write per site without
+# flipping ``enabled`` (which would tax the compiled executor's per-task
+# instrumentation branches)
+recorder: Callable[[Any, Any], None] | None = None
 
 
 def register(event: PinsEvent, cb: Callback) -> None:
@@ -69,6 +86,9 @@ def unregister(event: PinsEvent, cb: Callback) -> None:
 
 
 def fire(event: PinsEvent, es: Any = None, payload: Any = None) -> None:
+    r = recorder
+    if r is not None:
+        r(event, payload)
     if not enabled:
         return
     for cb in _chains.get(int(event), ()):  # snapshot-free: append-only lists

@@ -1,6 +1,6 @@
 """Runtime core (rebuild of the reference's layer 3, SURVEY §2.3, §3)."""
 
-from .context import Context
+from .context import Context, ContextWaitTimeout
 from .deps import DependencyTracking
 from .scheduling import (ExecutionStream, VirtualProcess, complete_execution,
                          execute_task, prepare_input, release_deps,
@@ -14,7 +14,8 @@ from .taskpool import CompoundTaskpool, Taskpool, compose, taskpool_lookup
 from .termdet import (LocalTermDet, TermDetMonitor, UserTriggerTermDet)
 
 __all__ = [
-    "Chore", "CompoundTaskpool", "Context", "DEV_CPU", "DEV_RECURSIVE",
+    "Chore", "CompoundTaskpool", "Context", "ContextWaitTimeout",
+    "DEV_CPU", "DEV_RECURSIVE",
     "DEV_TPU", "Dep", "DependencyTracking", "ExecutionStream", "FLOW_CTL",
     "Flow", "HOOK_RETURN_AGAIN", "HOOK_RETURN_ASYNC", "HOOK_RETURN_DISABLE",
     "HOOK_RETURN_DONE", "HOOK_RETURN_ERROR", "HOOK_RETURN_NEXT",

@@ -47,6 +47,14 @@ _params.register("props_stream_interval", 0.1,
                  "seconds between live property snapshots")
 
 
+class ContextWaitTimeout(TimeoutError):
+    """Deadline expiry of a bounded :meth:`Context.wait` /
+    :meth:`Context.fini` drain — the ONE TimeoutError that is benign
+    pacing, not a runtime failure.  Caught by type everywhere (the old
+    'context wait timed out' substring test was one reword away from
+    silently flipping fini()'s re-raise semantics, ADVICE round 5)."""
+
+
 class Context:
     def __init__(self, nb_cores: int | None = None,
                  scheduler: str | None = None,
@@ -54,6 +62,10 @@ class Context:
         from ..sched import ensure_registered as _sched_ensure
         _sched_ensure()
         from ..device import registry as device_registry
+        # the always-on flight recorder hooks pins.fire before any worker
+        # can emit an event (prof_flightrec_size=0 opts out)
+        from ..prof import flight_recorder as _flightrec
+        _flightrec.ensure_installed()
         if nb_cores is None:
             nb_cores = _params.get("runtime_num_cores")
         self.nb_cores = nb_cores
@@ -125,6 +137,8 @@ class Context:
             i += 1
         self._props_ns = ns
         self._props_stop: Callable[[], None] | None = None
+        self._snap_started = False
+        self.last_stall_report: dict | None = None
         ref = weakref.ref(self)
 
         def gauge(fn: Callable[["Context"], Any]) -> Callable[[], Any]:
@@ -228,6 +242,11 @@ class Context:
             from ..prof.counters import properties
             self._props_stop = properties.stream_to(
                 path, _params.get("props_stream_interval"))
+        interval = _params.get("prof_snapshot_interval")
+        if interval > 0 and not self._snap_started:
+            from ..prof import flight_recorder
+            flight_recorder.snapshotter.start(interval)
+            self._snap_started = True
         if self.comm_engine is not None:
             self.comm_engine.enable()
         self._start_barrier.set()
@@ -239,21 +258,56 @@ class Context:
             return not self._active_taskpools
 
     def wait(self, timeout: float | None = None) -> None:
-        """``parsec_context_wait``: block until every taskpool completes."""
+        """``parsec_context_wait``: block until every taskpool completes.
+        A deadline expiry raises :class:`ContextWaitTimeout` — and first
+        fires the flight-recorder stall dump, so a wedged run produces a
+        diagnosis (every worker's last events, queue depths, in-flight
+        comm, device state) instead of silence."""
         if not self.started:
             self.start()
-        self._drive_until(self.test, timeout)
+        try:
+            self._drive_until(self.test, timeout)
+        except ContextWaitTimeout:
+            self._stall_dump(f"context wait timed out (timeout={timeout}s)")
+            raise
 
-    def fini(self) -> None:
+    def _stall_dump(self, reason: str) -> dict | None:
+        if not _params.get("prof_stall_dump"):
+            return None
+        try:
+            from ..prof import flight_recorder
+            self.last_stall_report = flight_recorder.stall_dump(self, reason)
+        except Exception:      # the dump must never mask the timeout
+            pass
+        return self.last_stall_report
+
+    def fini(self, timeout: float | None = None) -> None:
         """``parsec_fini``: drain, stop workers, release the scheduler.
         A poisoned context (a recorded worker/driver failure) skips the
         drain — its taskpools can never complete — and tears down like
         :meth:`abort`; if no caller has seen the failure yet (it was
         recorded by a background thread and never raised from a wait),
         it is re-raised AFTER teardown so a crash cannot read as clean
-        success."""
+        success.
+
+        ``timeout`` bounds the drain (callers whose wait() already timed
+        out pass their expired deadline's remainder — ADVICE round 5:
+        an unbounded fini on a wedged relay hung forever in the exact
+        cleanup path added for the timed-out case).  On expiry the stall
+        dump fires (via :meth:`wait`) and teardown falls through
+        abort-style."""
         if self._worker_error is None and not self.test():
-            self.wait()
+            try:
+                if not self.started:
+                    self.start()
+                self._drive_until(self.test, timeout)
+            except ContextWaitTimeout:
+                # tear down abort-style below; dump only if a timed-out
+                # wait() didn't already (bench's finally re-enters with
+                # the expired deadline — one diagnosis per stall, not two)
+                if self.last_stall_report is None:
+                    self._stall_dump(
+                        f"fini drain timed out (timeout={timeout}s)")
         with self._lock:
             self._shutdown = True
             self._cond.notify_all()
@@ -293,6 +347,10 @@ class Context:
         if self._props_stop is not None:
             self._props_stop()
             self._props_stop = None
+        if self._snap_started:
+            from ..prof import flight_recorder
+            flight_recorder.snapshotter.release()
+            self._snap_started = False
         from ..prof.counters import properties
         for name in ("sched_pending", "active_taskpools", "nb_tasks", "sde"):
             properties.unregister(self._props_ns, name)
@@ -344,8 +402,7 @@ class Context:
         try:
             self._drive_until_inner(predicate, timeout)
         except BaseException as e:
-            if not (isinstance(e, TimeoutError)
-                    and "context wait timed out" in str(e)):
+            if not isinstance(e, ContextWaitTimeout):
                 self._error_surfaced = True
             raise
 
@@ -368,7 +425,7 @@ class Context:
                     rem = None if deadline is None else \
                         deadline - time.monotonic()
                     if rem is not None and rem <= 0:
-                        raise TimeoutError("context wait timed out")
+                        raise ContextWaitTimeout("context wait timed out")
                     # wake on termination, worker error, or a freshly
                     # enqueued compiled-DAG pool needing this driver
                     ok = self._cond.wait_for(
@@ -376,7 +433,7 @@ class Context:
                         or self._worker_error is not None
                         or self._has_pending_dag(), rem)
                     if not ok:
-                        raise TimeoutError("context wait timed out")
+                        raise ContextWaitTimeout("context wait timed out")
         self._run_compiled_dags(deadline=deadline)
         es = self._submit_es
         es.owner_ident = threading.get_ident()
@@ -388,7 +445,7 @@ class Context:
                 raise RuntimeError(
                     "a background thread failed") from self._worker_error
             if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError("context wait timed out")
+                raise ContextWaitTimeout("context wait timed out")
             try:
                 task, distance = select_task(es)
                 if task is None:
@@ -402,9 +459,9 @@ class Context:
                     continue
                 backoff.reset()
                 task_progress(es, task, distance)
+            except ContextWaitTimeout:
+                raise    # deadline expiry is not a context poison
             except TimeoutError as e:
-                if "context wait timed out" in str(e):
-                    raise    # deadline expiry is not a context poison
                 self.record_failure(e)   # a body's timeout IS a failure
                 raise
             except BaseException as e:
@@ -456,7 +513,7 @@ class Context:
                 # waiting on another pool's progress.  The pool stays
                 # pending and resumable either way.
                 if deadline is not None and time.monotonic() > deadline:
-                    raise TimeoutError("context wait timed out")
+                    raise ContextWaitTimeout("context wait timed out")
                 continue
             tp._compiled_dag = None
             tp.tdm.taskpool_addto_nb_tasks(-dag.ntasks)

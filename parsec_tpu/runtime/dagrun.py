@@ -27,10 +27,13 @@ back to the dynamic scheduler — same taskpool object, same results.
 
 PINS instrumentation does NOT force the fallback (the round-3 state, which
 made the 1.4µs hot loop unobservable — the reference profiles its real
-inner loop, ``mca/pins/pins_task_profiler.c``): with PINS active the
-executor fires batch-granular ``DAG_FETCH``/``DAG_COMPLETE`` spans (payload:
-batch size) and per-task ``EXEC`` begin/end around the bodies; with PINS
-off the hot loop is byte-identical to before (one bool test per batch).
+inner loop, ``mca/pins/pins_task_profiler.c``): the executor always fires
+batch-granular ``DAG_FETCH``/``DAG_COMPLETE`` spans (payload: batch size)
+through ``pins.fire`` — a handful of calls per 1024-task batch, which is
+how the always-on flight recorder sees the compiled path — and per-task
+``EXEC`` begin/end around the bodies only while PINS chains are
+registered; with everything off the per-task loop is byte-identical to
+before (one bool test per batch).
 """
 
 from __future__ import annotations
@@ -130,13 +133,16 @@ class _CompiledDagBase:
                 with self._lock:
                     self._claimed = False
                 return False
-            instr = pins.enabled        # one test per batch, not per task
-            if instr:
-                pins.fire(pins.PinsEvent.DAG_FETCH_BEGIN, es, None)
+            # batch-granular spans go through pins.fire unconditionally:
+            # the always-on flight recorder sees every fetch/complete (a
+            # handful of calls per 1024-task batch), while the per-task
+            # EXEC fires below stay gated on pins.enabled so the hot
+            # loop's per-task cost is untouched when only the recorder
+            # is active
+            pins.fire(pins.PinsEvent.DAG_FETCH_BEGIN, es, None)
             n = fetch(buf, _BATCH)
             ids = list(buf[:n]) if n else []
-            if instr:
-                pins.fire(pins.PinsEvent.DAG_FETCH_END, es, len(ids))
+            pins.fire(pins.PinsEvent.DAG_FETCH_END, es, len(ids))
             if not ids and not retry:
                 if self._ndag.remaining() == 0:
                     break
@@ -150,16 +156,13 @@ class _CompiledDagBase:
             if done:
                 self._noprog = 0
                 rem = -1
-                if instr:
-                    pins.fire(pins.PinsEvent.DAG_COMPLETE_BEGIN, es,
-                              len(done))
+                pins.fire(pins.PinsEvent.DAG_COMPLETE_BEGIN, es, len(done))
                 for off in range(0, len(done), _BATCH):
                     chunk = done[off:off + _BATCH]
                     for j, gid in enumerate(chunk):
                         buf[j] = gid
                     rem = complete(buf, len(chunk))
-                if instr:
-                    pins.fire(pins.PinsEvent.DAG_COMPLETE_END, es, len(done))
+                pins.fire(pins.PinsEvent.DAG_COMPLETE_END, es, len(done))
                 if rem == 0:
                     break
                 backoff.reset()

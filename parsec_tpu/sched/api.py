@@ -41,3 +41,9 @@ class SchedulerModule:
     def pending_tasks(self, context: Any) -> int:
         """Approximate queue depth (PAPI-SDE counter analog)."""
         return -1
+
+    def queue_depths(self, context: Any) -> dict[str, int]:
+        """Best-effort per-queue depth map for diagnostics (the flight
+        recorder's stall dump).  Modules with per-stream queues override
+        this; the base reports the shared total only."""
+        return {"shared": self.pending_tasks(context)}

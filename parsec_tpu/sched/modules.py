@@ -34,6 +34,23 @@ _params.register("sched_lfq_buffer_size", 8,
                         "per-stream bounded-buffer capacity for lfq")
 
 
+def _stream_queue_depths(context: Any) -> dict[str, int]:
+    """Shared per-stream depth map (lfq/pbq family shapes) for the
+    flight-recorder stall dump."""
+    out: dict[str, int] = {}
+    for vp in context.virtual_processes:
+        if vp.sched_private is not None and \
+                hasattr(vp.sched_private, "system"):
+            out[f"vp{vp.vp_id}.system"] = len(vp.sched_private.system)
+        for es in vp.execution_streams:
+            if es.sched_private is not None:
+                try:
+                    out[f"es{es.th_id}"] = len(es.sched_private)
+                except TypeError:
+                    pass
+    return out
+
+
 # ---------------------------------------------------------------------------
 # lfq — local flat queues (default; cf. sched/lfq, priority 20)
 # ---------------------------------------------------------------------------
@@ -102,6 +119,8 @@ class LFQModule(SchedulerModule):
                 if es.sched_private is not None:
                     n += len(es.sched_private)
         return n
+
+    queue_depths = staticmethod(_stream_queue_depths)
 
 
 # ---------------------------------------------------------------------------
@@ -413,6 +432,8 @@ class PBQModule(SchedulerModule):
                 if es.sched_private is not None:
                     n += len(es.sched_private)
         return n
+
+    queue_depths = staticmethod(_stream_queue_depths)
 
 
 class _Bundle:

@@ -132,3 +132,66 @@ def test_failing_stage_degrades_with_reason():
     res = bench._staged("boom", boom, timeout=5.0)
     assert res["gflops"] == 0.0
     assert "relay reset" in res["error"]
+
+
+def test_every_stage_carries_runtime_report(smoke_run):
+    """EVERY stage of the output JSON ships a flight-recorder
+    self-report — the per-stage runtime evidence the round-5 outage
+    proved is needed even (especially) when a stage degrades."""
+    p, _dt, _cwd = smoke_run
+    last = _json_lines(p.stdout)[-1]
+    reports = last["extra"]["runtime_reports"]
+    stage_names = {"dispatch", "gemm", "raw_dot", "stencil",
+                   "lowered_cholesky", "lowered_stencil", "lowered_lu",
+                   "dynamic_gemm", "dtd_gemm", "lowered_cholesky_16k",
+                   "dynamic_cholesky"}
+    assert stage_names <= set(reports), sorted(reports)
+    for name in stage_names:
+        assert "tasks_retired" in reports[name], (name, reports[name])
+    # degraded stages (if any) still carry their self-report
+    for name in last["extra"].get("degraded_stages", {}):
+        assert name in reports
+    # the dynamic stages really self-measured: retired counts are live
+    assert reports["dynamic_gemm"]["tasks_retired"] > 0
+
+
+def test_degraded_stages_carry_runtime_report():
+    """Timeout, exception, and budget-exhausted degrade paths all embed
+    the runtime self-report block (artificially degraded stages)."""
+    import bench
+    before = list(bench._abandoned)
+    try:
+        hung = bench._staged("rr-hang", lambda: time.sleep(30), timeout=0.3)
+        assert "runtime_report" in hung
+        assert "tasks_retired" in hung["runtime_report"]
+
+        def boom():
+            raise RuntimeError("relay reset")
+        failed = bench._staged("rr-boom", boom, timeout=5.0)
+        assert "runtime_report" in failed
+    finally:
+        bench._abandoned[:] = before
+
+
+def test_budget_exhausted_logs_and_uses_prior_taint(capsys):
+    """The budget-exhausted early return reports like the other degrade
+    paths: stderr line + prior-snapshot tainted_by (ADVICE round 5)."""
+    import bench
+    before = list(bench._abandoned)
+
+    def flaky():
+        raise RuntimeError("reset")
+
+    try:
+        bench._abandoned[:] = ["earlier-zombie"]
+        # timeout < 1s: the retry's remaining budget is under the 1.0s
+        # floor, so attempt 2 takes the budget-exhausted early return
+        res = bench._staged("rr-budget", flaky, timeout=0.5, retries=3)
+        assert "budget" in res["error"]
+        # prior snapshot: the pre-existing zombie, never the stage itself
+        assert res["tainted_by"] == ["earlier-zombie"]
+        assert "runtime_report" in res
+        err = capsys.readouterr().err
+        assert "budget" in err and "rr-budget" in err
+    finally:
+        bench._abandoned[:] = before
