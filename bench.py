@@ -306,10 +306,12 @@ def _time_lowered(low, sync_store: str, reps: int = 3):
     Returns ``(median_seconds, compile_seconds, last_out)`` — compile is
     attributed separately (VERDICT r4 weak #2: at O(wavefronts x classes)
     ops the XLA compile may itself be the wall; without the split the run
-    number is uninterpretable)."""
+    number is uninterpretable).  ``low.jitted()`` consults the process-wide
+    lowering cache, so a re-invoked identical stage reports a near-zero
+    ``*_compile_s`` instead of re-paying the trace+compile."""
     import jax
     st = {k: jax.device_put(v) for k, v in low.initial_stores().items()}
-    jf = jax.jit(low.step_fn)
+    jf = low.jitted()
     tc = time.perf_counter()
     out = jf(st)
     _ = float(out[sync_store].reshape(-1)[0])    # compile + warm
@@ -471,37 +473,38 @@ def bench_dtd_gemm_tpu(n: int = 8192, nb: int = 1024) -> dict:
             "batched_dispatches": dev.batched_dispatches}
 
 
+def bench_overhead() -> dict:
+    """The critical-path micro stage (microbench.py): dispatch latency,
+    dep-release throughput, lfq local-pop/steal latency, PINS site cost,
+    and lowering-cache compile times — ALL measurable with no accelerator,
+    so this stage runs FIRST and the perf axis can never go fully dark
+    again (ISSUE 2; round 5 shipped no dispatch evidence at all).  The
+    lowering-cache half touches jax, so it only runs when the platform is
+    explicitly CPU (a dark relay must not hang the always-first stage)."""
+    import os
+
+    from microbench import run_all
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    platform = (os.environ.get("BENCH_PLATFORM")
+                or os.environ.get("JAX_PLATFORMS") or "")
+    out = run_all(smoke=smoke, include_lowering=platform == "cpu")
+    out["gflops"] = 0.0   # not a throughput stage; keep the stage shape
+    return out
+
+
 def bench_dispatch_us(ntasks: int = 2000) -> float:
     """Per-task dispatch latency on the EP DAG (the reference's
     tests/runtime/scheduling/ep.jdf shape): enqueue-to-drain wall time over
     the task count.  Exercises the enqueue-time DAG compilation
     (runtime/dagrun.py) and the native select→release executor — the
     rebuild's answer to scheduling.c:562-575's C hot loop.  Pools the
-    compiler refuses take the dynamic Python scheduler instead."""
-    from parsec_tpu import ptg
-    from parsec_tpu.runtime import Context
-
-    NT, DEPTH = 50, ntasks // 50
-    p = ptg.PTGBuilder("ep", NT=NT, DEPTH=DEPTH)
-    t = p.task("EP",
-               d=ptg.span(0, lambda g, l: g.DEPTH - 1),
-               n=ptg.span(0, lambda g, l: g.NT - 1))
-    f = t.flow("ctl", ptg.CTL)
-    f.input(pred=("EP", "ctl", lambda g, l: {"d": l.d - 1, "n": l.n}),
-            guard=lambda g, l: l.d > 0)
-    f.output(succ=("EP", "ctl", lambda g, l: {"d": l.d + 1, "n": l.n}),
-             guard=lambda g, l: l.d < g.DEPTH - 1)
-    t.body(lambda es, task, g, l: None)
-    times = []
-    for _rep in range(5):   # median of 5: the metric is steady-state
-        tp = p.build()      # per-task latency, not one-time dlopen/import
-        ctx = Context(nb_cores=0)
-        t0 = time.perf_counter()
-        ctx.add_taskpool(tp)
-        ctx.wait(timeout=600)
-        times.append(time.perf_counter() - t0)
-        ctx.fini()
-    return statistics.median(times) / (NT * DEPTH) * 1e6
+    compiler refuses take the dynamic Python scheduler instead.  ONE
+    measurement implementation process-wide: this delegates to
+    microbench.py, so the dedicated stage and the overhead stage can never
+    drift into incomparable readings."""
+    from microbench import _drain_ep_us
+    us, _engaged = _drain_ep_us(ntasks, reps=5, compiled=True)
+    return us
 
 
 _abandoned: list = []    # stages whose worker thread outlived its timeout
@@ -636,6 +639,16 @@ def main() -> None:
     t_start = time.perf_counter()
     res: dict = {}
 
+    def _dispatch_us():
+        """The dispatch series value: the dedicated stage's reading, else
+        the overhead micro stage's, else absent (never a sentinel)."""
+        v = res.get("dispatch_us")
+        if isinstance(v, (int, float)) and v >= 0:
+            return v
+        ov = res.get("overhead", {})
+        w = ov.get("dispatch_us") if isinstance(ov, dict) else None
+        return w if isinstance(w, (int, float)) and w >= 0 else None
+
     def emit():
         gemm = res.get("gemm") or {}
         peak = gemm.get("peak_gflops") or 1.0
@@ -669,7 +682,15 @@ def main() -> None:
                 # framework/raw ~ 1.0 = the taskpool lowering costs nothing
                 "raw_dot_gflops": round(
                     res.get("raw_dot", {}).get("gflops", 0.0), 1),
-                "task_dispatch_us": res.get("dispatch_us", -1.0),
+                # a MISSING dispatch measurement is omitted (formerly a
+                # -1.0 sentinel that poisoned trend averages over
+                # BENCH_r*.json); the overhead micro stage's reading
+                # backstops a skipped/failed dispatch stage
+                **({"task_dispatch_us": _dispatch_us()}
+                   if _dispatch_us() is not None else {}),
+                "overhead": {k: v for k, v in
+                             res.get("overhead", {}).items()
+                             if k not in ("runtime_report", "gflops")},
                 "dynamic_gemm_gflops": round(dyn.get("gflops", 0.0), 1),
                 "dynamic_gemm_batched": dyn.get("batched_dispatches", 0),
                 "dynamic_gemm_breakdown": dyn.get("breakdown", {}),
@@ -751,9 +772,14 @@ def main() -> None:
         "dchol": dict(n=512, nb=128) if smoke else {},
     }
 
-    # --- primary metrics first: a headline must land within minutes ---
+    # --- the overhead micro stage runs FIRST, before anything that can
+    # touch the relay: dispatch/release/steal numbers land even when
+    # every accelerator stage is dark (ISSUE 2 satellite) ---
+    stage("overhead", bench_overhead, timeout=120.0, primary=True)
+
+    # --- primary metrics next: a headline must land within minutes ---
     d = _staged("dispatch", bench_dispatch_us, timeout=90.0)
-    res["dispatch_us"] = round(d, 2) if isinstance(d, float) else -1.0
+    res["dispatch_us"] = round(d, 2) if isinstance(d, float) else None
     # the dispatch stage's self-report rides like every other stage's
     # (its headline value stays the flat task_dispatch_us key)
     res["dispatch"] = d if isinstance(d, dict) else \

@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Critical-path micro-benchmarks: the per-task fixed cost, measured on CPU.
+
+The runtime's value proposition is micro-task scheduling overhead in the low
+microseconds (PAPER.md; MPK and Design-in-Tiles both argue the per-task fixed
+cost, not the kernels, is the lever for fine-grained tensor programs).  This
+harness measures exactly that fixed cost — select→prepare→exec→complete→
+release — with NOTHING accelerator-dependent, so the perf axis stays
+measurable even when the TPU relay is dark:
+
+- ``bench_dispatch_us``        — per-task latency on the EP CTL DAG through
+  the compiled-DAG executor (the headline ``task_dispatch_us`` series) and
+  through the dynamic Python scheduler (``dynamic_dispatch_us``);
+- ``bench_release_throughput`` — dep-release tasks/s through the dynamic
+  path (``release_deps`` → batched ``DependencyTracking.release_many``);
+- ``bench_steal_us``           — lfq local-pop and steal latency against the
+  sharded per-stream deques (sched/modules.py);
+- ``bench_pins_disabled_ns``   — cost of one DISABLED instrumentation site
+  (the per-event dispatch-slot fast path, prof/pins.py);
+- ``bench_lowering_cache``     — first-vs-second compile seconds of an
+  identical lowered taskpool (the persistent lowering cache,
+  ptg/lowering.py).
+
+``python microbench.py`` prints one JSON object and finishes in seconds on a
+CPU-only host.  ``run_all(smoke=True)`` shrinks every config for CI; the
+``perf_smoke`` tier-1 marker (tests/test_perf_smoke.py) runs that with 10×
+headroom thresholds so gross dispatch-path regressions fail fast without
+timing flakes.  docs/PERF.md maps each number to the code it measures.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+
+
+def _ep_pool(NT: int, DEPTH: int):
+    """The reference's tests/runtime/scheduling/ep.jdf shape: NT independent
+    lanes of DEPTH chained CTL-only tasks."""
+    from parsec_tpu import ptg
+
+    p = ptg.PTGBuilder("ep", NT=NT, DEPTH=DEPTH)
+    t = p.task("EP",
+               d=ptg.span(0, lambda g, l: g.DEPTH - 1),
+               n=ptg.span(0, lambda g, l: g.NT - 1))
+    f = t.flow("ctl", ptg.CTL)
+    f.input(pred=("EP", "ctl", lambda g, l: {"d": l.d - 1, "n": l.n}),
+            guard=lambda g, l: l.d > 0)
+    f.output(succ=("EP", "ctl", lambda g, l: {"d": l.d + 1, "n": l.n}),
+             guard=lambda g, l: l.d < g.DEPTH - 1)
+    t.body(lambda es, task, g, l: None)
+    return p
+
+
+def _drain_ep_us(ntasks: int, reps: int, compiled: bool) -> tuple:
+    """Median enqueue-to-drain wall time per task in µs, plus whether the
+    compiled-DAG executor actually engaged (it silently declines when the
+    native extension is unavailable — the reading must say which path it
+    measured, or the dispatch trend mixes incomparable series)."""
+    import parsec_tpu.runtime.dagrun  # noqa: F401 — runtime_dag_compile
+    from parsec_tpu.core.params import params
+    from parsec_tpu.runtime import Context
+
+    NT = 50
+    DEPTH = max(ntasks // NT, 2)
+    builder = _ep_pool(NT, DEPTH)
+    saved = params.get("runtime_dag_compile")
+    params.set("runtime_dag_compile", compiled)
+    engaged = False
+    try:
+        times = []
+        for _ in range(reps):
+            tp = builder.build()
+            ctx = Context(nb_cores=0)
+            t0 = time.perf_counter()
+            ctx.add_taskpool(tp)
+            engaged = getattr(tp, "_compiled_dag", None) is not None
+            ctx.wait(timeout=600)
+            times.append(time.perf_counter() - t0)
+            ctx.fini()
+        return statistics.median(times) / (NT * DEPTH) * 1e6, engaged
+    finally:
+        params.set("runtime_dag_compile", saved)
+
+
+def bench_dispatch_us(ntasks: int = 10000, reps: int = 5) -> dict:
+    us, engaged = _drain_ep_us(ntasks, reps, True)
+    return {"dispatch_us": round(us, 3), "ntasks": ntasks,
+            "dispatch_path": "compiled" if engaged else "dynamic"}
+
+
+def bench_release_throughput(ntasks: int = 10000, reps: int = 3) -> dict:
+    """Dynamic-path drain: every non-startup task arrives through
+    ``release_deps`` → ``release_many``, so tasks/s here IS dep-release +
+    schedule throughput (body is empty)."""
+    us, _ = _drain_ep_us(ntasks, reps, False)
+    return {"dynamic_dispatch_us": round(us, 3),
+            "release_tasks_per_s": round(1e6 / us, 1),
+            "ntasks": ntasks}
+
+
+class _BenchTask:
+    __slots__ = ("priority",)
+
+    def __init__(self) -> None:
+        self.priority = 0
+
+
+def bench_steal_us(n: int = 200, reps: int = 50) -> dict:
+    """lfq local-pop vs steal latency on the sharded per-stream deques,
+    driven through the real scheduler module (no Context needed)."""
+    import parsec_tpu.sched  # noqa: F401 — registers components + params
+    from parsec_tpu.sched.modules import LFQModule
+    from parsec_tpu.runtime.scheduling import ExecutionStream, VirtualProcess
+
+    class _Ctx:
+        virtual_processes: list = []
+
+    ctx = _Ctx()
+    vp = VirtualProcess(0, ctx)
+    ctx.virtual_processes = [vp]
+    es0 = ExecutionStream(0, vp, ctx)
+    es1 = ExecutionStream(1, vp, ctx)
+    vp.execution_streams = [es0, es1]
+    mod = LFQModule()
+    mod.install(ctx)
+    mod.flow_init(es0)
+    mod.flow_init(es1)
+    n = min(n, mod._cap)      # beyond capacity spills to the system queue
+    tasks = [_BenchTask() for _ in range(n)]
+
+    def run(selector_es) -> float:
+        best = None
+        for _ in range(reps):
+            mod.schedule(es0, list(tasks), 0)
+            t0 = time.perf_counter()
+            for _i in range(n):
+                t, _d = mod.select(selector_es)
+                assert t is not None
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best / n * 1e6
+
+    return {"local_pop_us": round(run(es0), 4),
+            "steal_us": round(run(es1), 4), "n": n}
+
+
+def bench_pins_disabled_ns(iters: int = 200000) -> dict:
+    """One DISABLED instrumentation site (index load + falsy branch) vs
+    the always-on recorder-enabled site, through the same dispatch-slot
+    pattern the scheduling loop compiles in (prof/pins.py).  The recorder
+    is detached for the disabled half and restored after."""
+    from parsec_tpu.prof import pins
+
+    hooks = pins.hooks
+    ev = int(pins.PinsEvent.EXEC_BEGIN)
+    payload = object()
+
+    def run() -> float:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            h = hooks[ev]
+            if h is not None:
+                h(None, payload)
+        return (time.perf_counter() - t0) / iters * 1e9
+
+    saved = pins.recorder
+    pins.recorder = None
+    try:
+        disabled = run() if hooks[ev] is None else None
+    finally:
+        pins.recorder = saved
+    out = {"pins_disabled_ns": round(disabled, 2)
+           if disabled is not None else None}
+    if hooks[ev] is not None:       # always-on recorder (or chains) present
+        out["pins_enabled_ns"] = round(run(), 2)
+    return out
+
+
+def bench_lowering_cache(n: int = 96, nb: int = 32) -> dict:
+    """Two structurally identical lowerings of a tiled GEMM: the second
+    must hit the process-wide lowering cache and skip trace+compile."""
+    import numpy as np
+
+    from parsec_tpu.data_dist.matrix import TiledMatrix
+    from parsec_tpu.models.tiled_gemm import tiled_gemm_ptg
+    from parsec_tpu.ptg.lowering import lower_taskpool, lowering_cache
+
+    def once() -> float:
+        rng = np.random.default_rng(7)
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        A = TiledMatrix.from_dense("A", a.copy(), nb, nb)
+        B = TiledMatrix.from_dense("B", a.copy(), nb, nb)
+        C = TiledMatrix.from_dense("C", np.zeros((n, n), np.float32), nb, nb)
+        low = lower_taskpool(tiled_gemm_ptg(A, B, C))
+        st = low.initial_stores()
+        t0 = time.perf_counter()
+        out = low.jitted()(st)
+        float(np.asarray(out["C"]).reshape(-1)[0])
+        return time.perf_counter() - t0
+
+    h0, m0 = lowering_cache.hits, lowering_cache.misses
+    cold = once()
+    warm = once()
+    return {"compile_cold_s": round(cold, 4),
+            "compile_warm_s": round(warm, 4),
+            "cache_hits": lowering_cache.hits - h0,
+            "cache_misses": lowering_cache.misses - m0}
+
+
+def run_all(smoke: bool = False, include_lowering: bool = True) -> dict:
+    """Every micro number in one dict (the bench `overhead` stage payload).
+    ``include_lowering=False`` skips the only jax-touching section — the
+    scheduling-path numbers then need no accelerator stack at all."""
+    ntasks = 2000 if smoke else 10000
+    reps = 3 if smoke else 5
+    out: dict = {}
+    out.update(bench_dispatch_us(ntasks, reps))
+    out.update(bench_release_throughput(ntasks, max(reps - 2, 1)))
+    out.update(bench_steal_us())
+    out.update(bench_pins_disabled_ns(50000 if smoke else 200000))
+    if include_lowering:
+        try:
+            out.update(bench_lowering_cache())
+        except Exception as e:            # noqa: BLE001 — evidence over abort
+            out["lowering_cache_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+    smoke = os.environ.get("BENCH_SMOKE") == "1" or "--smoke" in sys.argv
+    print(json.dumps(run_all(smoke=smoke)))

@@ -11,7 +11,97 @@ selection.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from typing import Any, Callable
+
+
+class StealDeque:
+    """Sharded per-stream ready queue: the lock-free-common-path variant of
+    :class:`HBBuffer` (the lfq fast path).
+
+    Ownership discipline: exactly ONE thread (the owning stream's worker)
+    pops locally; any thread may push; thieves pop the other end.  CPython
+    deque operations (``extend``/``pop``/``popleft``/``__len__``) are each
+    a single C call and therefore atomic under the GIL, which makes the
+    common path LOCK-FREE:
+
+    - owner pop  = ``deque.pop()``   (newest end — LIFO locality),
+    - push       = ``deque.extend()`` (oldest-to-newest),
+    - steal      = ``deque.popleft()`` under ``_steal_lock`` — the lock
+      only serializes thieves against each other and against the priority
+      scan; owner/steal pops race benignly (opposite ends; at length 1
+      exactly one wins, the loser sees empty).
+
+    Priority degradation: the moment any pushed task carries a nonzero
+    priority the queue flips (one-way) into *priority mode*, where the
+    owner's pop becomes the same locked best-priority scan HBBuffer does —
+    the scan's index arithmetic is only safe when thieves cannot shift the
+    left end, hence the shared lock.  Pure-FIFO DAGs (priority 0
+    everywhere, the overwhelmingly common case) never take a lock on
+    push or local pop.
+
+    Overflow spills the tail to ``parent_push`` exactly like HBBuffer; the
+    capacity check is advisory (concurrent pushers may briefly overshoot),
+    which is sound — capacity bounds locality, not correctness.
+    """
+
+    __slots__ = ("capacity", "_parent_push", "_dq", "_steal_lock", "_prio")
+
+    def __init__(self, capacity: int,
+                 parent_push: Callable[[list[Any], int], None]) -> None:
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._parent_push = parent_push
+        self._dq: deque = deque()
+        self._steal_lock = threading.Lock()
+        self._prio = False        # one-way flip: stays sticky once set
+
+    def __len__(self) -> int:
+        return len(self._dq)
+
+    def push_all(self, items: list[Any], distance: int = 0) -> None:
+        dq = self._dq
+        if not self._prio:
+            for t in items:
+                if t.priority:
+                    self._prio = True
+                    break
+        room = self.capacity - len(dq)
+        if room >= len(items):
+            dq.extend(items)
+            return
+        if room > 0:
+            dq.extend(items[:room])
+            items = items[room:]
+        self._parent_push(list(items), distance + 1)
+
+    def try_pop_best(self, priority: Callable[[Any], float] | None = None
+                     ) -> Any | None:
+        if priority is None or not self._prio:
+            try:
+                return self._dq.pop()
+            except IndexError:
+                return None
+        with self._steal_lock:
+            dq = self._dq
+            n = len(dq)
+            if not n:
+                return None
+            # left indices are stable under the lock (thieves excluded;
+            # concurrent pushes only append on the right)
+            best_i = max(range(n), key=lambda i: priority(dq[i]))
+            t = dq[best_i]
+            del dq[best_i]
+            return t
+
+    def steal(self) -> Any | None:
+        """Victim-side pop from the *oldest* end (work-stealing fairness)."""
+        with self._steal_lock:
+            try:
+                return self._dq.popleft()
+            except IndexError:
+                return None
 
 
 class HBBuffer:

@@ -46,9 +46,12 @@ from .pins import PinsEvent
 _params.register("prof_flightrec_size", 256,
                  "per-worker flight-recorder ring capacity "
                  "(events kept per thread; 0 disables the recorder)")
-_params.register("prof_flightrec_dir", ".",
+_params.register("prof_flightrec_dir",
+                 os.environ.get("PARSEC_TPU_ARTIFACT_DIR", "/tmp"),
                  "directory stall-dump artifacts (flightrec-<rank>.json) "
-                 "are written to; empty = stderr only")
+                 "are written to (default: $PARSEC_TPU_ARTIFACT_DIR, else "
+                 "/tmp — never the CWD, which a repo checkout may be); "
+                 "empty = stderr only")
 _params.register("prof_stall_dump", True,
                  "dump flight-recorder state to stderr + artifact when a "
                  "Context.wait()/fini() drain times out")

@@ -3,13 +3,34 @@
 Rebuild of ``parsec/mca/pins/pins.h:26-120``: modules register begin/end
 callbacks on runtime events (SELECT, PREPARE_INPUT, EXEC, COMPLETE_EXEC,
 SCHEDULE, RELEASE_DEPS, ...); the runtime fires them from fixed points in the
-scheduling loop.  Dispatch cost when nothing is registered is one attribute
-load + truth test per site (the macro-compiled-out analog).
+scheduling loop.
+
+Dispatch is a table of **precompiled per-event slots** (:data:`hooks`): slot
+``i`` is either ``None`` (nothing attached to event ``i``) or a closure that
+delivers ``(es, payload)`` to the recorder and/or the registered chains.  A
+hot-loop fire site is therefore::
+
+    h = _hooks[_EXEC_BEGIN]          # _hooks = pins.hooks, bound at import
+    if h is not None:
+        h(es, task)
+
+— one index load plus a falsy branch with ZERO allocation when the site is
+disabled (the macro-compiled-out analog), and exactly one call when enabled.
+The :data:`hooks` list object never changes identity; slots are swapped in
+place by :func:`_rebuild`, so call sites may bind the list once at import.
+
+:func:`fire` remains the compatible slow-path entry (used by warm sites and
+tests); ``pins.recorder`` remains assignable exactly as in the flight-recorder
+contract — the module intercepts the assignment (module-class property) and
+retargets every slot, so a recorder installed by direct attribute write is
+seen by the precompiled sites immediately.
 """
 
 from __future__ import annotations
 
+import sys
 import threading
+import types
 from enum import IntEnum
 from typing import Any, Callable
 
@@ -56,16 +77,65 @@ class PinsEvent(IntEnum):
 
 Callback = Callable[[Any, Any], None]   # (execution_stream_or_none, payload)
 
+N_EVENTS = max(int(e) for e in PinsEvent) + 1
+
 _lock = threading.Lock()
 _chains: dict[int, list[Callback]] = {}
 enabled = False
 
 # the flight-recorder hook (prof/flight_recorder.py): a callable
 # ``(event, payload) -> None`` or None.  Kept separate from the callback
-# chains so the always-on recorder costs one list write per site without
+# chains so the always-on recorder costs one slot call per site without
 # flipping ``enabled`` (which would tax the compiled executor's per-task
-# instrumentation branches)
-recorder: Callable[[Any, Any], None] | None = None
+# instrumentation branches).  Exposed as the assignable ``pins.recorder``
+# attribute through the module-class property below.
+_recorder: Callable[[Any, Any], None] | None = None
+
+# the per-event dispatch table.  IDENTITY-STABLE: hot call sites bind this
+# list object once at import; _rebuild() swaps slots in place.
+hooks: list[Callable[[Any, Any], None] | None] = [None] * N_EVENTS
+
+
+def _slot(event: int) -> Callable[[Any, Any], None] | None:
+    """Compile one event's dispatch slot from the current recorder/chains."""
+    rec = _recorder
+    chain = _chains.get(event)
+    if not chain:
+        chain = None
+    if rec is None and chain is None:
+        return None
+    ev = PinsEvent(event)
+    if chain is None:
+        def h(es: Any, payload: Any, _r=rec, _e=ev) -> None:
+            _r(_e, payload)
+        return h
+    if rec is None:
+        def h(es: Any, payload: Any, _c=chain) -> None:
+            for cb in _c:               # snapshot-free: append-only lists
+                cb(es, payload)
+        return h
+
+    def h(es: Any, payload: Any, _r=rec, _c=chain, _e=ev) -> None:
+        _r(_e, payload)
+        for cb in _c:
+            cb(es, payload)
+    return h
+
+
+def _rebuild() -> None:
+    """Recompile every slot (caller holds ``_lock``, or is single-threaded
+    module init).  In-place assignment keeps the table identity stable."""
+    for i in range(N_EVENTS):
+        hooks[i] = _slot(i)
+
+
+def set_recorder(value: Callable[[Any, Any], None] | None) -> None:
+    """Install/clear the flight-recorder hook and retarget every slot.
+    ``pins.recorder = fn`` routes here through the module-class setter."""
+    global _recorder
+    with _lock:
+        _recorder = value
+        _rebuild()
 
 
 def register(event: PinsEvent, cb: Callback) -> None:
@@ -73,6 +143,7 @@ def register(event: PinsEvent, cb: Callback) -> None:
     with _lock:
         _chains.setdefault(int(event), []).append(cb)
         enabled = True
+        _rebuild()
 
 
 def unregister(event: PinsEvent, cb: Callback) -> None:
@@ -80,16 +151,31 @@ def unregister(event: PinsEvent, cb: Callback) -> None:
     with _lock:
         lst = _chains.get(int(event), [])
         if cb in lst:
-            # copy-on-write: fire() iterates these lists unlocked
+            # copy-on-write: slots iterate these lists unlocked
             _chains[int(event)] = [c for c in lst if c is not cb]
         enabled = any(_chains.values())
+        _rebuild()
 
 
 def fire(event: PinsEvent, es: Any = None, payload: Any = None) -> None:
-    r = recorder
-    if r is not None:
-        r(event, payload)
-    if not enabled:
-        return
-    for cb in _chains.get(int(event), ()):  # snapshot-free: append-only lists
-        cb(es, payload)
+    h = hooks[event]
+    if h is not None:
+        h(es, payload)
+
+
+class _PinsModule(types.ModuleType):
+    """Intercepts ``pins.recorder`` assignment: the flight recorder (and
+    its tests) install by plain attribute write, which must retarget the
+    precompiled slots — a raw module global could be rebound behind the
+    dispatch table's back."""
+
+    @property
+    def recorder(self) -> Callable[[Any, Any], None] | None:
+        return _recorder
+
+    @recorder.setter
+    def recorder(self, value: Callable[[Any, Any], None] | None) -> None:
+        set_recorder(value)
+
+
+sys.modules[__name__].__class__ = _PinsModule

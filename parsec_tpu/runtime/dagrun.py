@@ -56,6 +56,14 @@ _params.register("runtime_dag_max_tasks", 1 << 20,
 
 _BATCH = 1024
 
+# PINS fast path (prof/pins.py): identity-stable dispatch table — a
+# disabled batch-span site is one index load + falsy branch
+_hooks = pins.hooks
+_DAG_FETCH_BEGIN = int(pins.PinsEvent.DAG_FETCH_BEGIN)
+_DAG_FETCH_END = int(pins.PinsEvent.DAG_FETCH_END)
+_DAG_COMPLETE_BEGIN = int(pins.PinsEvent.DAG_COMPLETE_BEGIN)
+_DAG_COMPLETE_END = int(pins.PinsEvent.DAG_COMPLETE_END)
+
 
 class _Ineligible(Exception):
     """Structure outside the compiled-DAG subset; run dynamically."""
@@ -133,16 +141,20 @@ class _CompiledDagBase:
                 with self._lock:
                     self._claimed = False
                 return False
-            # batch-granular spans go through pins.fire unconditionally:
-            # the always-on flight recorder sees every fetch/complete (a
-            # handful of calls per 1024-task batch), while the per-task
-            # EXEC fires below stay gated on pins.enabled so the hot
-            # loop's per-task cost is untouched when only the recorder
-            # is active
-            pins.fire(pins.PinsEvent.DAG_FETCH_BEGIN, es, None)
+            # batch-granular spans fire through the dispatch slots
+            # unconditionally: the always-on flight recorder sees every
+            # fetch/complete (a handful of calls per 1024-task batch),
+            # while the per-task EXEC fires below stay gated on
+            # pins.enabled so the hot loop's per-task cost is untouched
+            # when only the recorder is active
+            h = _hooks[_DAG_FETCH_BEGIN]
+            if h is not None:
+                h(es, None)
             n = fetch(buf, _BATCH)
             ids = list(buf[:n]) if n else []
-            pins.fire(pins.PinsEvent.DAG_FETCH_END, es, len(ids))
+            h = _hooks[_DAG_FETCH_END]
+            if h is not None:
+                h(es, len(ids))
             if not ids and not retry:
                 if self._ndag.remaining() == 0:
                     break
@@ -156,13 +168,17 @@ class _CompiledDagBase:
             if done:
                 self._noprog = 0
                 rem = -1
-                pins.fire(pins.PinsEvent.DAG_COMPLETE_BEGIN, es, len(done))
+                h = _hooks[_DAG_COMPLETE_BEGIN]
+                if h is not None:
+                    h(es, len(done))
                 for off in range(0, len(done), _BATCH):
                     chunk = done[off:off + _BATCH]
                     for j, gid in enumerate(chunk):
                         buf[j] = gid
                     rem = complete(buf, len(chunk))
-                pins.fire(pins.PinsEvent.DAG_COMPLETE_END, es, len(done))
+                h = _hooks[_DAG_COMPLETE_END]
+                if h is not None:
+                    h(es, len(done))
                 if rem == 0:
                     break
                 backoff.reset()
@@ -250,16 +266,37 @@ def _scratch(dtt) -> Any:
     return scratch_copy(dtt)    # same allocation policy as prepare_input
 
 
+def _locals_ns_builder(names: tuple):
+    """eval-compile ``lambda d, n: _NS(d=d, n=n)`` for one class's params —
+    the jdf2c precompilation stance applied to locals construction: one
+    call builds the body's ``l`` namespace AND (via its ``__dict__``) the
+    task's locals dict, replacing a dict(zip) plus a namespace copy per
+    task.  None when a param name can't appear in a lambda signature."""
+    import keyword
+    if any(not n.isidentifier() or keyword.iskeyword(n)
+           or n.startswith("_") for n in names):
+        return None
+    from ..ptg.dsl import _NS
+    if not names:
+        return lambda: _NS()
+    args = ", ".join(names)
+    kw = ", ".join(f"{n}={n}" for n in names)
+    return eval(f"lambda {args}: _NS({kw})", {"_NS": _NS})
+
+
 class VecCompiledDag(_CompiledDagBase):
     """Vector-compiled pure-CTL taskpool: locals live in index arrays.
 
     The graph was built by array-evaluating every guard/target map once over
     the whole execution space (``_build_vector``); at run time, task locals
     are materialized per batch with one numpy gather per parameter — the
-    per-task Python work is one dict, one minimal Task, one body call.
+    per-task Python work is one namespace, one minimal Task, one direct
+    body call (the PTG hook wrapper is bypassed through its ``ptg_body``
+    seam; hooks without the seam take the generic path).
     """
 
-    __slots__ = ("_cls_of", "_base", "_names", "_cols", "_hooks", "_tcs")
+    __slots__ = ("_cls_of", "_base", "_names", "_cols", "_hooks", "_tcs",
+                 "_bodies", "_gns", "_mks")
 
     def __init__(self, taskpool, ndag, cls_of, base, names, cols, hooks,
                  tcs) -> None:
@@ -270,6 +307,9 @@ class VecCompiledDag(_CompiledDagBase):
         self._cols = cols          # per class list of per-param int arrays
         self._hooks = hooks        # per class chore hook
         self._tcs = tcs            # per class TaskClass
+        self._bodies = [getattr(h, "ptg_body", None) for h in hooks]
+        self._gns = [getattr(h, "ptg_gns", None) for h in hooks]
+        self._mks = [_locals_ns_builder(nm) for nm in names]
 
     def _exec_batch(self, es: Any, ids_list: list) -> tuple[list, list]:
         cls_of = self._cls_of
@@ -293,6 +333,8 @@ class VecCompiledDag(_CompiledDagBase):
         for ci, sel in groups:
             names = self._names[ci]
             hook = self._hooks[ci]
+            body = self._bodies[ci]
+            mk = self._mks[ci]
             tc = self._tcs[ci]
             rel = sel - self._base[ci]
             cols = [c[rel].tolist() for c in self._cols[ci]]
@@ -308,6 +350,38 @@ class VecCompiledDag(_CompiledDagBase):
             instr = pins.enabled
             fire = pins.fire
             EB, EE = pins.PinsEvent.EXEC_BEGIN, pins.PinsEvent.EXEC_END
+            if body is not None and mk is not None:
+                # fast path: hook wrapper bypassed; `l` is built once and
+                # its __dict__ doubles as task.locals (same key/value view)
+                g = self._gns[ci]()
+                for gid, row in zip(gids, rows):
+                    lns = mk(*row)
+                    t = new_task(Task)
+                    t.taskpool = tp
+                    t.task_class = tc
+                    t.locals = lns.__dict__
+                    t.priority = 0
+                    t.status = "ready"
+                    t.data = empty
+                    t.repo_entries = empty
+                    t.uid = gid
+                    t.chore_mask = nchores
+                    t.selected_device = None
+                    t.on_complete = None
+                    if instr:
+                        fire(EB, es, t)
+                        rc = body(es, t, g, lns)
+                        fire(EE, es, t)
+                    else:
+                        rc = body(es, t, g, lns)
+                    if rc is not None and rc != DONE:
+                        if rc == AGAIN:
+                            retry.append(gid)
+                            continue
+                        raise RuntimeError(
+                            f"compiled DAG: {tc.name} returned rc={rc}")
+                    done.append(gid)
+                continue
             for gid, row in zip(gids, rows):
                 t = new_task(Task)
                 t.taskpool = tp

@@ -22,11 +22,18 @@ from ..core.params import params as _params
 from .task import Task, TaskClass
 
 _params.register(
-    "deps_storage", "hash",
-    "dep-tracker storage: 'hash' (parsec_hash_find_deps) or "
-    "'index-array' (parsec_default_find_deps — dense per-class arrays "
-    "over static execution-space boxes; non-eligible classes fall back "
-    "to the hashed tier)")
+    "deps_storage", "index-array",
+    "dep-tracker storage: 'index-array' (parsec_default_find_deps — "
+    "dense per-class arrays over static execution-space boxes, the "
+    "default: non-eligible classes fall back to the hashed tier, and "
+    "batched release takes one lock per class group) or 'hash' "
+    "(parsec_hash_find_deps only)")
+_params.register(
+    "deps_index_array_max_slots", 1 << 22,
+    "largest static-box volume (slots) the index-array tier will "
+    "allocate densely; bigger boxes — e.g. the mostly-empty cube of a "
+    "large triangular space — fall back to the hashed tier instead of "
+    "materializing gigabytes of empty tracker slots")
 
 # 64-bit key layout for the native dep table: [tpid:10][tcid:6][params:48].
 # Packing is *exact* (injective) or refused — a non-packable key falls back
@@ -85,7 +92,8 @@ class _IndexArrayStore:
     array carries its own lock — slots of unrelated classes never
     contend (the hashed tier's per-key locking analog)."""
 
-    __slots__ = ("_arrays", "_lock", "_dead", "allocated", "releases")
+    __slots__ = ("_arrays", "_lock", "_dead", "_fits", "allocated",
+                 "releases")
 
     def __init__(self) -> None:
         self._arrays: dict[tuple, tuple] = {}   # akey -> (lock, list)
@@ -95,8 +103,25 @@ class _IndexArrayStore:
         # plus stashed inputs); ids are per-context monotonically
         # assigned, so the set is bounded by finished pools
         self._dead: set[int] = set()
+        # box-volume eligibility memo, keyed by the extents tuple itself
+        # (volume is a pure function of it) — the hot release path pays a
+        # dict hit, not a product loop
+        self._fits: dict[tuple, bool] = {}
         self.allocated = 0    # arrays created (SDE-style engagement proof)
         self.releases = 0     # dep records through the indexed tier
+
+    def fits(self, extents: tuple) -> bool:
+        """Whether a static box is small enough to back densely — beyond
+        ``deps_index_array_max_slots`` (a large triangular space's mostly
+        empty cube) the class takes the hashed tier instead."""
+        ok = self._fits.get(extents)
+        if ok is None:
+            size = 1
+            for lo, stop in extents:
+                size *= max(stop - lo, 0)
+            ok = self._fits[extents] = \
+                size <= _params.get("deps_index_array_max_slots")
+        return ok
 
     @staticmethod
     def slot(extents: tuple, tkey: tuple) -> int | None:
@@ -139,8 +164,8 @@ class DependencyTracking:
     Storage tiers sharing one protocol: the **native** C++ dep table
     (mask bookkeeping behind one atomic call, keyed by an exact 64-bit
     packing of the task identity), the **Python** tracker table (any key
-    shape), and — behind ``deps_storage=index-array`` — dense per-class
-    arrays over static execution-space boxes.  Data-carrying deps stash
+    shape), and — under the default ``deps_storage=index-array`` — dense
+    per-class arrays over static execution-space boxes.  Data-carrying deps stash
     their input copies in a side dict either way; the pure-CTL hot path
     (the dispatch benchmark's EP DAG) never touches Python locks with
     the native tier on.
@@ -177,12 +202,7 @@ class DependencyTracking:
             return self._release_counted(taskpool, tc, locals_, tkey,
                                          flow_index, data_copy, repo_ref)
         bit = 1 << tc.dep_bit(flow_index, dep_index)
-        if self._index_store is not None and tc.find_deps_fn is None \
-                and tc.make_key_fn is None \
-                and tc.space_extents is not None:
-            # make_key_fn excluded: a UD key is injective but not
-            # positionally aligned with the param-range extents, so
-            # direct linearization could collide distinct tasks
+        if self._indexed_eligible(tc):
             li = _IndexArrayStore.slot(tc.space_extents, tkey)
             if li is not None:
                 return self._release_indexed(taskpool, tc, locals_, li, bit,
@@ -214,6 +234,94 @@ class DependencyTracking:
             return None
         return self._make_ready(taskpool, tc, locals_, trk.inputs,
                                 trk.repo_refs)
+
+    def _indexed_eligible(self, tc: TaskClass) -> bool:
+        """Whether a class's deps may take the dense index-array tier.
+        The ONE predicate both release paths share — a split would route a
+        single-record release and a batched release of the same successor
+        through different trackers and hang the pool.  make_key_fn is
+        excluded because a UD key is injective but not positionally
+        aligned with the param-range extents (direct linearization could
+        collide distinct tasks); oversized boxes fall to the hashed tier
+        (:meth:`_IndexArrayStore.fits`)."""
+        store = self._index_store
+        return (store is not None and not tc.counted
+                and tc.find_deps_fn is None and tc.make_key_fn is None
+                and tc.space_extents is not None
+                and store.fits(tc.space_extents))
+
+    def release_many(self, taskpool: Any,
+                     records: list[tuple]) -> list[Task]:
+        """Batched release of one completing task's successor deps.
+
+        ``records`` is a list of ``(tc, locals_, flow_index, dep_index,
+        data_copy, repo_ref)`` tuples.  Records eligible for the dense
+        index-array tier are grouped per task class and released under ONE
+        lock acquisition per group (the batched-dep-release half of the
+        critical-path fast path); everything else goes record-at-a-time
+        through :meth:`release_dep`.  Returns every task that became ready.
+        """
+        ready: list[Task] = []
+        if self._index_store is not None and len(records) > 1:
+            by_class: dict[int, list] = {}
+            tcs: dict[int, TaskClass] = {}
+            rest: list[tuple] = []
+            for rec in records:
+                tc = rec[0]
+                if self._indexed_eligible(tc):
+                    li = _IndexArrayStore.slot(tc.space_extents,
+                                               tc.make_key(rec[1]))
+                    if li is not None:
+                        cid = tc.task_class_id
+                        by_class.setdefault(cid, []).append((rec, li))
+                        tcs[cid] = tc
+                        continue
+                rest.append(rec)
+            for cid, grp in by_class.items():
+                ready.extend(self._release_indexed_batch(taskpool, tcs[cid],
+                                                         grp))
+            records = rest
+        for tc, locals_, fi, di, data_copy, repo_ref in records:
+            t = self.release_dep(taskpool, tc, locals_, fi, di, data_copy,
+                                 repo_ref)
+            if t is not None:
+                ready.append(t)
+        return ready
+
+    def _release_indexed_batch(self, taskpool: Any, tc: TaskClass,
+                               grp: list[tuple]) -> list[Task]:
+        """Same mask protocol as :meth:`_release_indexed`, amortizing the
+        class-array lock over a whole batch of same-class releases."""
+        store = self._index_store
+        entry = store.array(taskpool, tc)
+        if entry is None:
+            return []        # taskpool already purged: late releases dropped
+        lock, arr = entry
+        done: list[tuple] = []
+        with lock:
+            cur = store._arrays.get((taskpool.taskpool_id,
+                                     tc.task_class_id))
+            if cur is None or cur[1] is not arr:
+                return []    # purged between lookup and lock (abort race)
+            store.releases += len(grp)
+            for (_, locals_, fi, di, data_copy, repo_ref), li in grp:
+                bit = 1 << tc.dep_bit(fi, di)
+                trk = arr[li]
+                if trk is None:
+                    trk = arr[li] = _DepTracker(tc.input_dep_mask(locals_),
+                                                len(tc.flows))
+                assert not (trk.satisfied_mask & bit), \
+                    f"dep {tc.name}[{li}] bit {bit} satisfied twice"
+                trk.satisfied_mask |= bit
+                if data_copy is not None:
+                    trk.inputs[fi] = data_copy
+                    trk.repo_refs[fi] = repo_ref
+                if trk.satisfied_mask == trk.required_mask:
+                    arr[li] = None
+                    done.append((locals_, trk))
+        return [self._make_ready(taskpool, tc, locals_, trk.inputs,
+                                 trk.repo_refs)
+                for locals_, trk in done]
 
     def _release_indexed(self, taskpool: Any, tc: TaskClass, locals_: dict,
                          li: int, bit: int, flow_index: int,

@@ -33,6 +33,24 @@ _params.register(
     "enable expensive runtime invariant checks "
     "(the PARSEC_DEBUG_PARANOID build-mode analog, SURVEY §5.2)")
 
+# PINS fast path: the dispatch table's identity is stable (slots swap in
+# place), so each site is one index load + falsy branch when disabled —
+# no call, no argument tuple (prof/pins.py)
+_hooks = pins.hooks
+_SELECT_BEGIN = int(PinsEvent.SELECT_BEGIN)
+_SELECT_END = int(PinsEvent.SELECT_END)
+_SELECT_STEAL = int(PinsEvent.SELECT_STEAL)
+_PREPARE_INPUT_BEGIN = int(PinsEvent.PREPARE_INPUT_BEGIN)
+_PREPARE_INPUT_END = int(PinsEvent.PREPARE_INPUT_END)
+_EXEC_BEGIN = int(PinsEvent.EXEC_BEGIN)
+_EXEC_END = int(PinsEvent.EXEC_END)
+_COMPLETE_EXEC_BEGIN = int(PinsEvent.COMPLETE_EXEC_BEGIN)
+_COMPLETE_EXEC_END = int(PinsEvent.COMPLETE_EXEC_END)
+_SCHEDULE_BEGIN = int(PinsEvent.SCHEDULE_BEGIN)
+_SCHEDULE_END = int(PinsEvent.SCHEDULE_END)
+_RELEASE_DEPS_BEGIN = int(PinsEvent.RELEASE_DEPS_BEGIN)
+_RELEASE_DEPS_END = int(PinsEvent.RELEASE_DEPS_END)
+
 # paranoid writeback ledger lock: the (owner, version) mark lives on the
 # home copy itself (DataCopy.wb_mark), so state dies with the copy and
 # distinct taskpools never cross-talk
@@ -77,7 +95,9 @@ def schedule_tasks(es: ExecutionStream, tasks: list[Task],
     """``__parsec_schedule``: hand ready tasks to the scheduler module."""
     if not tasks:
         return
-    pins.fire(PinsEvent.SCHEDULE_BEGIN, es, tasks)
+    h = _hooks[_SCHEDULE_BEGIN]
+    if h is not None:
+        h(es, tasks)
     keep = _params.get("runtime_keep_highest_priority_task")
     # next_task is a single-owner slot: only the thread running this stream's
     # hot loop may touch it (a device manager or comm thread completing a
@@ -88,21 +108,29 @@ def schedule_tasks(es: ExecutionStream, tasks: list[Task],
         es.next_task = tasks.pop()  # highest priority stays hot
     if tasks:
         es.context.scheduler.schedule(es, tasks, distance)
-    pins.fire(PinsEvent.SCHEDULE_END, es, tasks)
+    h = _hooks[_SCHEDULE_END]
+    if h is not None:
+        h(es, tasks)
 
 
 def select_task(es: ExecutionStream) -> tuple[Task | None, int]:
     if es.next_task is not None:
         t, es.next_task = es.next_task, None
         return t, 0
-    pins.fire(PinsEvent.SELECT_BEGIN, es)
+    h = _hooks[_SELECT_BEGIN]
+    if h is not None:
+        h(es, None)
     t, distance = es.context.scheduler.select(es)
-    pins.fire(PinsEvent.SELECT_END, es, t)
+    h = _hooks[_SELECT_END]
+    if h is not None:
+        h(es, t)
     if t is not None and 0 < distance < 99:
         # work pulled from ANOTHER stream's queue: a steal.  Distance 99
         # is the schedulers' shared-system-queue sentinel — popping an
         # externally-submitted task is starvation relief, not a steal
-        pins.fire(PinsEvent.SELECT_STEAL, es, (t, distance))
+        h = _hooks[_SELECT_STEAL]
+        if h is not None:
+            h(es, (t, distance))
     return t, distance
 
 
@@ -114,7 +142,9 @@ def execute_task(es: ExecutionStream, task: Task) -> int:
     """``__parsec_execute``: walk the class's chores honoring the task's
     chore mask and the evaluate/hook return protocol."""
     tc = task.task_class
-    pins.fire(PinsEvent.EXEC_BEGIN, es, task)
+    h = _hooks[_EXEC_BEGIN]
+    if h is not None:
+        h(es, task)
     try:
         for i, chore in enumerate(tc.chores):
             if not (task.chore_mask & (1 << i)) or not chore.enabled:
@@ -133,14 +163,20 @@ def execute_task(es: ExecutionStream, task: Task) -> int:
             return rc
         return HOOK_RETURN_ERROR
     finally:
-        pins.fire(PinsEvent.EXEC_END, es, task)
+        h = _hooks[_EXEC_END]
+        if h is not None:
+            h(es, task)
 
 
 def task_progress(es: ExecutionStream, task: Task, distance: int) -> int:
     """``__parsec_task_progress``: one task through its lifecycle."""
-    pins.fire(PinsEvent.PREPARE_INPUT_BEGIN, es, task)
+    h = _hooks[_PREPARE_INPUT_BEGIN]
+    if h is not None:
+        h(es, task)
     prepare_input(es, task)
-    pins.fire(PinsEvent.PREPARE_INPUT_END, es, task)
+    h = _hooks[_PREPARE_INPUT_END]
+    if h is not None:
+        h(es, task)
     rc = execute_task(es, task)
     if rc == HOOK_RETURN_DONE:
         complete_execution(es, task)
@@ -246,7 +282,9 @@ def _find_input_dep(succ_tc: TaskClass, flow_name: str, src_class: str,
 def complete_execution(es: ExecutionStream, task: Task) -> None:
     """``__parsec_complete_execution``: outputs → repo/collection, successor
     release, input-repo consumption, task retirement."""
-    pins.fire(PinsEvent.COMPLETE_EXEC_BEGIN, es, task)
+    h = _hooks[_COMPLETE_EXEC_BEGIN]
+    if h is not None:
+        h(es, task)
     tc = task.task_class
     tp = task.taskpool
     if tc.complete_execution is not None:
@@ -269,7 +307,9 @@ def complete_execution(es: ExecutionStream, task: Task) -> None:
     task.status = "done"
     if task.on_complete is not None:
         task.on_complete(task)
-    pins.fire(PinsEvent.COMPLETE_EXEC_END, es, task)
+    h = _hooks[_COMPLETE_EXEC_END]
+    if h is not None:
+        h(es, task)
     tp.tdm.taskpool_addto_nb_tasks(-1)
 
 
@@ -278,14 +318,23 @@ def release_deps(es: ExecutionStream, task: Task) -> None:
     per-edge visitor ``parsec_release_dep_fct``, ``parsec.c:1759``): walk
     active out-deps; write-back edges update the collection; successor edges
     update dep trackers, collecting now-ready tasks; remote successors
-    accumulate into a remote-deps set activated through the comm engine."""
-    pins.fire(PinsEvent.RELEASE_DEPS_BEGIN, es, task)
+    accumulate into a remote-deps set activated through the comm engine.
+
+    Successor releases are BATCHED: the visitor only accumulates release
+    records; one :meth:`DependencyTracking.release_many
+    <parsec_tpu.runtime.deps.DependencyTracking.release_many>` call after
+    the walk performs them grouped per class (one lock acquisition per
+    dense-tier group), and the resulting ready set is pushed to the
+    scheduler in a single ``schedule_tasks`` call."""
+    h = _hooks[_RELEASE_DEPS_BEGIN]
+    if h is not None:
+        h(es, task)
     tc = task.task_class
     tp = task.taskpool
     ctx = tp.context
     entry = None
     nconsumers = 0
-    ready: list[Task] = []
+    pending: list[tuple] = []   # deferred successor-release records
     remote = None
 
     def visitor(t: Task, flow, dep) -> None:
@@ -334,17 +383,17 @@ def release_deps(es: ExecutionStream, task: Task) -> None:
                 # not the producer's copy (read-side reshape)
                 send = reshape_for_edge(out_copy, dep,
                                         succ_tc.flows[fi].deps_in[di])
-            ready_task = ctx.deps.release_dep(tp, succ_tc, succ_locals, fi,
-                                              di, send, repo_ref)
-            if ready_task is not None:
-                ready.append(ready_task)
+            pending.append((succ_tc, succ_locals, fi, di, send, repo_ref))
 
     tc.iterate_successors(task, visitor)
     if entry is not None:
         entry.addto_usage_limit(nconsumers)
     if remote is not None:
         ctx.remote_dep_activate(es, task, remote)
-    pins.fire(PinsEvent.RELEASE_DEPS_END, es, task)
+    ready = ctx.deps.release_many(tp, pending) if pending else None
+    h = _hooks[_RELEASE_DEPS_END]
+    if h is not None:
+        h(es, task)
     if ready:
         schedule_tasks(es, ready, 0)
 

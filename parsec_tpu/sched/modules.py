@@ -23,15 +23,21 @@ from collections import deque
 from typing import Any, Sequence
 
 from ..core.params import params as _params
-from ..core.hbbuffer import HBBuffer
+from ..core.hbbuffer import HBBuffer, StealDeque
 from ..core.mca import Component, component
 # imported at module load (main thread): the topology affinity snapshot
 # must be taken before any worker binds itself to a single core
 from ..core import topology as _topology
 from .api import SchedulerModule
 
-_params.register("sched_lfq_buffer_size", 8,
-                        "per-stream bounded-buffer capacity for lfq")
+_params.register("sched_lfq_buffer_size", 256,
+                 "per-stream sharded-deque capacity for lfq (spills to the "
+                 "per-VP system queue beyond this; large enough that a "
+                 "release batch stays on the lock-free local path)")
+
+
+def _task_priority(t: Any) -> int:
+    return t.priority
 
 
 def _stream_queue_depths(context: Any) -> dict[str, int]:
@@ -62,6 +68,12 @@ class _VPQueues:
 
 
 class LFQModule(SchedulerModule):
+    """Sharded ready queues: the per-ES :class:`StealDeque` is the primary
+    push target — owner push/pop are GIL-atomic deque operations with no
+    lock, and a lock is taken only on steal, overflow spill, or the
+    priority-scan degradation (core/hbbuffer.py).  Cross-worker contention
+    on the common select→release path is therefore zero."""
+
     name = "lfq"
 
     def install(self, context: Any) -> None:
@@ -76,19 +88,21 @@ class LFQModule(SchedulerModule):
             with vpq.lock:
                 vpq.system.extend(items)
 
-        es.sched_private = HBBuffer(self._cap, parent_push=overflow)
+        es.sched_private = StealDeque(self._cap, parent_push=overflow)
 
     def schedule(self, es: Any, tasks: Sequence[Any], distance: int = 0) -> None:
-        if es.sched_private is None or distance > 0:
+        sp = es.sched_private
+        if sp is None or distance > 0:
             vpq = es.virtual_process.sched_private
             with vpq.lock:
                 vpq.system.extend(tasks)
             return
-        es.sched_private.push_all(list(tasks), distance)
+        sp.push_all(tasks if type(tasks) is list else list(tasks), distance)
 
     def select(self, es: Any) -> tuple[Any | None, int]:
-        if es.sched_private is not None:
-            t = es.sched_private.try_pop_best(priority=lambda x: x.priority)
+        sp = es.sched_private
+        if sp is not None:
+            t = sp.try_pop_best(priority=_task_priority)
             if t is not None:
                 return t, 0
         # steal from sibling streams in the same VP (never across VPs)
@@ -401,8 +415,7 @@ class PBQModule(SchedulerModule):
 
     def select(self, es: Any) -> tuple[Any | None, int]:
         if es.sched_private is not None:
-            t = es.sched_private.try_pop_best(
-                priority=lambda x: x.priority)
+            t = es.sched_private.try_pop_best(priority=_task_priority)
             if t is not None:
                 return t, 0
             for d, sib in enumerate(self._steal_order(es)):
@@ -531,14 +544,13 @@ class LHQModule(PBQModule):
 
     def select(self, es: Any) -> tuple[Any | None, int]:
         if es.sched_private is not None:
-            t = es.sched_private.try_pop_best(
-                priority=lambda x: x.priority)
+            t = es.sched_private.try_pop_best(priority=_task_priority)
             if t is not None:
                 return t, 0
             my_grp = self._group_of(es)
             # the stream's OWN hierarchy: its buffer's spill target is not
             # another stream's queue, so this is distance 0 (not a steal)
-            t = my_grp.try_pop_best(priority=lambda x: x.priority)
+            t = my_grp.try_pop_best(priority=_task_priority)
             if t is not None:
                 return t, 0
             for d, sib in enumerate(self._steal_order(es)):

@@ -127,3 +127,21 @@ def test_triangular_space_falls_back_cleanly(param):
     ctx.wait(timeout=60)
     ctx.fini()
     assert len(done) == 6 * 7 // 2
+
+
+def test_oversized_static_box_falls_back_to_hashed_tier(param):
+    """A static box bigger than deps_index_array_max_slots must NOT be
+    materialized densely (gigabytes of empty slots for a mostly-empty
+    space) — the class silently takes the hashed tier instead."""
+    param("deps_storage", "index-array")
+    param("deps_index_array_max_slots", 16)   # force the guard
+    param("runtime_dag_compile", False)
+    ctx = Context(nb_cores=0)
+    store = ctx.deps._index_store
+    assert store is not None
+    tp = _ep_pool(8, 6)          # box volume 48 > 16
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=60)
+    assert store.allocated == 0, "dense array allocated despite the cap"
+    assert store.releases == 0
+    ctx.fini()

@@ -128,6 +128,80 @@ def test_disabled_path_is_allocation_free():
         pins.recorder = old_rec
 
 
+def test_disabled_dispatch_slot_is_none_and_allocation_free():
+    """The ISSUE-2 fast path: hot sites read ``pins.hooks[event]`` — with
+    nothing attached the slot IS None, and the slot-pattern loop (index
+    load + falsy branch, exactly what scheduling.py compiles in) allocates
+    nothing."""
+    old_rec = pins.recorder
+    pins.recorder = None
+    try:
+        if pins.enabled:
+            pytest.skip("a PINS chain is registered by another test")
+        hooks = pins.hooks
+        ev = int(PinsEvent.EXEC_BEGIN)
+        assert hooks[ev] is None
+        payload = object()
+        it = range(1000)          # loop machinery allocated up front
+        tracemalloc.start()
+        before, _ = tracemalloc.get_traced_memory()
+        for _ in it:
+            h = hooks[ev]
+            if h is not None:
+                h(None, payload)
+        after, _ = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        # zero PER-SITE allocation: 1000 disabled sites may not grow the
+        # heap by even half a byte per visit
+        assert after - before < 512, (before, after)
+    finally:
+        pins.recorder = old_rec
+
+
+def test_recorder_assignment_retargets_dispatch_slots():
+    """``pins.recorder = fn`` (the PR-1 install contract AND this file's
+    fixtures) must retarget the precompiled slots immediately — and the
+    hooks LIST identity must never change, since hot sites bind it once
+    at import."""
+    table_before = pins.hooks
+    seen = []
+    old_rec = pins.recorder
+    pins.recorder = lambda ev, payload: seen.append((ev, payload))
+    try:
+        h = pins.hooks[int(PinsEvent.EXEC_BEGIN)]
+        assert h is not None
+        h(None, 42)
+        assert seen == [(PinsEvent.EXEC_BEGIN, 42)]
+        pins.fire(PinsEvent.DAG_COMPLETE_END, None, 7)   # fire() same table
+        assert seen[-1] == (PinsEvent.DAG_COMPLETE_END, 7)
+    finally:
+        pins.recorder = old_rec
+    assert pins.hooks is table_before
+    assert pins.recorder is old_rec
+
+
+def test_chain_registration_compiles_slots_and_unregister_clears():
+    calls = []
+
+    def cb(es, payload):
+        calls.append(payload)
+
+    old_rec = pins.recorder
+    pins.recorder = None
+    try:
+        ev = PinsEvent.DATA_FLUSH_BEGIN
+        if pins.hooks[int(ev)] is not None:
+            pytest.skip("another module holds a chain on this event")
+        pins.register(ev, cb)
+        assert pins.hooks[int(ev)] is not None
+        pins.fire(ev, None, "x")
+        assert calls == ["x"]
+        pins.unregister(ev, cb)
+        assert pins.hooks[int(ev)] is None
+    finally:
+        pins.recorder = old_rec
+
+
 # ---------------------------------------------------------------------------
 # stall dump
 # ---------------------------------------------------------------------------

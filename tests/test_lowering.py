@@ -356,3 +356,94 @@ def test_writeback_bumps_versions():
     v0 = C.data_of(0, 0).newest_copy().version
     lower_taskpool(tiled_gemm_ptg(A, B, C)).execute()
     assert C.data_of(0, 0).newest_copy().version == v0 + 1
+
+
+# ---------------------------------------------------------------------------
+# persistent lowering/compile cache (ISSUE 2)
+# ---------------------------------------------------------------------------
+
+def test_lowering_cache_hit_reuses_executable_and_matches_miss():
+    """Two structurally identical lowerings share ONE jitted executable
+    (the second invocation pays no trace/compile) and produce identical
+    numerics — hit == miss bit-for-bit."""
+    from parsec_tpu.ptg.lowering import lowering_cache
+
+    a, b, A, B, C = _gemm_fixture(n=12, nb=4, seed=3)
+    low1 = lower_taskpool(tiled_gemm_ptg(A, B, C))
+    h0, m0 = lowering_cache.hits, lowering_cache.misses
+    jf1 = low1.jitted()
+    out1 = np.asarray(jf1(low1.initial_stores())["C"])
+
+    a2, b2, A2, B2, C2 = _gemm_fixture(n=12, nb=4, seed=3)
+    low2 = lower_taskpool(tiled_gemm_ptg(A2, B2, C2))
+    assert low2.signature == low1.signature
+    jf2 = low2.jitted()
+    assert jf2 is jf1, "second lowering must hit the executable cache"
+    assert lowering_cache.hits >= h0 + 1
+    out2 = np.asarray(jf2(low2.initial_stores())["C"])
+    np.testing.assert_array_equal(out1, out2)
+    # identity tile grids lower to the dense store layout: out IS [n, n]
+    np.testing.assert_allclose(out1, a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_lowering_cache_second_invocation_compile_is_near_zero():
+    """The acceptance pin: a repeat lowered stage in one process shows
+    near-zero *_compile_s.  Warm must be at least 10x under cold (cold
+    includes a real XLA compile; warm is a dict hit + cached call)."""
+    import time
+
+    def once(seed):
+        _, _, A, B, C = _gemm_fixture(n=16, nb=4, seed=seed)
+        low = lower_taskpool(tiled_gemm_ptg(A, B, C))
+        st = low.initial_stores()
+        t0 = time.perf_counter()
+        out = low.jitted()(st)
+        float(np.asarray(out["C"]).reshape(-1)[0])
+        return time.perf_counter() - t0
+
+    cold = once(seed=11)
+    warm = once(seed=11)
+    assert warm <= max(cold / 10.0, 0.05), (cold, warm)
+
+
+def test_lowering_cache_distinguishes_different_structures():
+    """Structurally different programs must carry different signatures
+    (no false sharing of executables).  Same kernel + same collection
+    names + different wavefront structure (stencil sweep lengths) is the
+    sharpest case: only the emitted level plan differs."""
+    from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
+    from parsec_tpu.models.stencil import stencil_1d_ptg
+
+    def low(iters):
+        V = VectorTwoDimCyclic("V", lm=1 << 10, mb=1 << 8, P=1,
+                               init_fn=lambda m, size:
+                               np.zeros(size, np.float32))
+        w = np.full(3, 1.0 / 3.0)
+        return lower_taskpool(stencil_1d_ptg(V, w, iters))
+
+    l4, l8 = low(4), low(8)
+    assert l4.mode == l8.mode == "wavefront"
+    assert l4.signature != l8.signature
+
+
+def test_lowering_cache_param_disables_sharing(param):
+    param("lowering_cache", False)
+    _, _, A, B, C = _gemm_fixture(n=12, nb=4, seed=5)
+    low1 = lower_taskpool(tiled_gemm_ptg(A, B, C))
+    _, _, A2, B2, C2 = _gemm_fixture(n=12, nb=4, seed=5)
+    low2 = lower_taskpool(tiled_gemm_ptg(A2, B2, C2))
+    assert low1.jitted() is not low2.jitted()
+
+
+def test_lowered_execute_goes_through_cache():
+    """LoweredTaskpool.execute() (the collection-writeback convenience)
+    rides the same cached executable."""
+    a, b, A, B, C = _gemm_fixture(n=8, nb=4, seed=6)
+    low1 = lower_taskpool(tiled_gemm_ptg(A, B, C))
+    low1.execute()
+    a2, b2, A2, B2, C2 = _gemm_fixture(n=8, nb=4, seed=6)
+    low2 = lower_taskpool(tiled_gemm_ptg(A2, B2, C2))
+    low2.execute()
+    assert low2._jitted is low1._jitted
+    np.testing.assert_allclose(C.to_dense(), C2.to_dense(), rtol=1e-5)
+    np.testing.assert_allclose(C.to_dense(), a @ b, rtol=1e-4, atol=1e-4)

@@ -1,0 +1,62 @@
+"""perf_smoke: critical-path regression guards over microbench.py.
+
+Every threshold carries ~10x headroom over the numbers measured at ISSUE-2
+time (docs/PERF.md records those), so a pass is timing-flake-safe in CI
+while a genuine dispatch-path regression — an accidental allocation in a
+PINS site, a lock on the lfq common path, a lost compile-cache hit — still
+fails loudly.  The whole module runs in a few seconds on CPU and is part
+of tier-1 (it is deliberately NOT marked slow)."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import microbench  # noqa: E402
+
+pytestmark = pytest.mark.perf_smoke
+
+# measured on the ISSUE-2 CPU baseline (docs/PERF.md):  dispatch 1.3-1.6us,
+# dynamic 40-50us, steal 0.8us, local pop 0.3us, pins disabled ~30ns
+DISPATCH_US_MAX = 16.0
+DYNAMIC_DISPATCH_US_MAX = 500.0
+RELEASE_TASKS_PER_S_MIN = 2000.0
+LOCAL_POP_US_MAX = 4.0
+STEAL_US_MAX = 10.0
+PINS_DISABLED_NS_MAX = 500.0
+
+
+def test_compiled_dispatch_latency():
+    r = microbench.bench_dispatch_us(ntasks=2000, reps=3)
+    assert r["dispatch_us"] <= DISPATCH_US_MAX, r
+
+
+def test_dynamic_release_throughput():
+    r = microbench.bench_release_throughput(ntasks=2000, reps=1)
+    assert r["dynamic_dispatch_us"] <= DYNAMIC_DISPATCH_US_MAX, r
+    assert r["release_tasks_per_s"] >= RELEASE_TASKS_PER_S_MIN, r
+
+
+def test_lfq_pop_and_steal_latency():
+    r = microbench.bench_steal_us(n=200, reps=20)
+    assert r["local_pop_us"] <= LOCAL_POP_US_MAX, r
+    assert r["steal_us"] <= STEAL_US_MAX, r
+
+
+def test_pins_disabled_site_cost():
+    r = microbench.bench_pins_disabled_ns(iters=50000)
+    # None = a PINS chain was registered by a concurrently-running module;
+    # the dedicated allocation test (test_flight_recorder) still guards it
+    if r["pins_disabled_ns"] is None:
+        pytest.skip("PINS chains registered; disabled site unmeasurable")
+    assert r["pins_disabled_ns"] <= PINS_DISABLED_NS_MAX, r
+
+
+def test_lowering_cache_warm_compile_is_near_zero():
+    r = microbench.bench_lowering_cache(n=64, nb=32)
+    assert r["cache_hits"] >= 1, r
+    # warm "compile" is a dict lookup + cached-executable call: even with
+    # 10x headroom it must land far under the cold trace+compile
+    assert r["compile_warm_s"] <= max(0.1 * r["compile_cold_s"], 0.05), r
