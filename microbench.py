@@ -19,7 +19,10 @@ measurable even when the TPU relay is dark:
   (the per-event dispatch-slot fast path, prof/pins.py);
 - ``bench_lowering_cache``     — first-vs-second compile seconds of an
   identical lowered taskpool (the persistent lowering cache,
-  ptg/lowering.py).
+  ptg/lowering.py);
+- ``bench_serve``              — sustained submissions/s and p50/p99
+  ticket latency through a RuntimeServer: concurrent client threads,
+  two tenants, one hot context (the serving layer, parsec_tpu/serve/).
 
 ``python microbench.py`` prints one JSON object and finishes in seconds on a
 CPU-only host.  ``run_all(smoke=True)`` shrinks every config for CI; the
@@ -209,10 +212,69 @@ def bench_lowering_cache(n: int = 96, nb: int = 32) -> dict:
             "cache_misses": lowering_cache.misses - m0}
 
 
-def run_all(smoke: bool = False, include_lowering: bool = True) -> dict:
+def bench_serve(nsub: int = 64, nthreads: int = 4, depth: int = 8,
+                nb_cores: int = 2) -> dict:
+    """Serving-path fixed cost: ``nthreads`` client threads submit
+    ``nsub`` small CTL-chain pools (4 lanes x ``depth``, the EP shape)
+    into one hot :class:`RuntimeServer` under two tenants, each blocking
+    on its ticket — sustained submissions/s plus p50/p99 end-to-end
+    ticket latency.  Pure scheduler path (no accelerator, no lowering):
+    the serving layer's admission + fair-queue + live-enqueue overhead
+    is what this measures."""
+    import threading
+
+    from parsec_tpu.serve import RuntimeServer
+
+    lat: list[float] = []
+    lock = threading.Lock()
+    errors: list[BaseException] = []
+    server = RuntimeServer(nb_cores=nb_cores)
+    per = max(nsub // nthreads, 1)
+
+    def client(tenant: str) -> None:
+        try:
+            for _i in range(per):
+                tp = _ep_pool(4, depth).build()
+                t0 = time.perf_counter()
+                tk = server.submit(tp, tenant=tenant)
+                tk.result(timeout=120)
+                dt = time.perf_counter() - t0
+                with lock:
+                    lat.append(dt)
+        except BaseException as e:      # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(f"tenant{i % 2}",),
+                                name=f"serve-client{i}")
+               for i in range(nthreads)]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t0
+    server.drain(timeout=60)
+    if errors:
+        raise errors[0]
+    lat.sort()
+    n = len(lat)
+    return {
+        "serve_submits_per_s": round(n / wall, 1),
+        "serve_p50_ms": round(lat[n // 2] * 1e3, 3),
+        "serve_p99_ms": round(lat[min(int(n * 0.99), n - 1)] * 1e3, 3),
+        "serve_nsub": n,
+        "serve_threads": nthreads,
+        "serve_tasks_per_sub": 4 * depth,
+    }
+
+
+def run_all(smoke: bool = False, include_lowering: bool = True,
+            include_serve: bool = True) -> dict:
     """Every micro number in one dict (the bench `overhead` stage payload).
     ``include_lowering=False`` skips the only jax-touching section — the
-    scheduling-path numbers then need no accelerator stack at all."""
+    scheduling-path numbers then need no accelerator stack at all.
+    ``include_serve=False`` skips the serving numbers (bench.py runs them
+    in its dedicated ``serve`` stage instead of twice)."""
     ntasks = 2000 if smoke else 10000
     reps = 3 if smoke else 5
     out: dict = {}
@@ -220,6 +282,9 @@ def run_all(smoke: bool = False, include_lowering: bool = True) -> dict:
     out.update(bench_release_throughput(ntasks, max(reps - 2, 1)))
     out.update(bench_steal_us())
     out.update(bench_pins_disabled_ns(50000 if smoke else 200000))
+    if include_serve:
+        out.update(bench_serve(nsub=16 if smoke else 64,
+                               depth=4 if smoke else 8))
     if include_lowering:
         try:
             out.update(bench_lowering_cache())

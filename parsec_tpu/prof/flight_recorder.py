@@ -457,6 +457,17 @@ def runtime_report(max_workers: int = 6) -> dict:
                             + rep["dag_tasks_completed"])
     rep["h2d_bytes"] = vsums[PinsEvent.DEVICE_STAGE_IN]
     rep["comm_activations_sent"] = counts[PinsEvent.COMM_ACTIVATE_SEND]
+    if counts[PinsEvent.SERVE_SUBMIT]:
+        # serving-layer lifecycle tallies (serve/server.py): present only
+        # when a RuntimeServer ran, so batch runs stay byte-compatible
+        rep["serve"] = {
+            "submitted": counts[PinsEvent.SERVE_SUBMIT],
+            "admitted": counts[PinsEvent.SERVE_ADMIT],
+            "rejected": counts[PinsEvent.SERVE_REJECT],
+            "started": counts[PinsEvent.SERVE_START],
+            "completed": counts[PinsEvent.SERVE_COMPLETE],
+            "drains": counts[PinsEvent.SERVE_DRAIN],
+        }
     now = _now()
 
     def activity(ring: _Ring) -> int:

@@ -73,6 +73,16 @@ class PinsEvent(IntEnum):
     # comm sites (comm/remote_dep.py)
     COMM_ACTIVATE_SEND = 29        # payload: (dst_rank, seq)
     COMM_ACK_RECV = 30             # payload: seq
+    # serving-layer lifecycle sites (serve/server.py) — payload:
+    # (tenant, taskpool_name).  Every submission walks SUBMIT → {ADMIT →
+    # START → COMPLETE | REJECT}; DRAIN fires once per server drain, so
+    # the flight recorder covers the serving path out of the box
+    SERVE_SUBMIT = 31
+    SERVE_ADMIT = 32
+    SERVE_REJECT = 33
+    SERVE_START = 34
+    SERVE_COMPLETE = 35
+    SERVE_DRAIN = 36
 
 
 Callback = Callable[[Any, Any], None]   # (execution_stream_or_none, payload)

@@ -81,8 +81,18 @@ class Context:
         self.comm_engine: Any = None
         # rank-agreed taskpool ids for the wire protocol: ranks enqueue
         # taskpools in the same order, so the per-context sequence agrees
-        # (the parsec_taskpool_reserve_id / sync_ids analog, parsec.c:2038)
+        # (the parsec_taskpool_reserve_id / sync_ids analog, parsec.c:2038).
+        # The id is a monotonic counter, NOT len(taskpool_list): with live
+        # enqueue a long-lived context retires terminated pools from the
+        # list, and a length-derived id would recycle and collide.
         self._tp_by_comm_id: dict[int, Taskpool] = {}
+        self._next_comm_id = 0
+        # serializes whole add_taskpool calls: concurrent client threads
+        # submitting into a RUNNING context (the serving shape) must see
+        # an atomic id-reserve + termdet-arm + startup-schedule sequence —
+        # RLock because compound pools re-enter from completion callbacks
+        self._submit_lock = threading.RLock()
+        self._failure_listeners: list[Callable[[BaseException], None]] = []
         self._worker_error: BaseException | None = None
         # whether the recorded failure has been raised to a caller —
         # fini() re-raises a failure nobody has seen yet (a silently
@@ -172,11 +182,22 @@ class Context:
     def add_taskpool(self, tp: Taskpool, local_only: bool = False) -> None:
         """``parsec_context_add_taskpool`` (``scheduling.c:850``).
 
+        Thread-safe and **live**: may be called from any thread while the
+        workers are running (the serving shape, ``parsec_tpu/serve/``).
+        The whole enqueue — comm-id reservation, termdet arming, startup
+        enumeration, initial schedule — runs under ``_submit_lock``, so
+        concurrent submissions keep the rank-agreed taskpool-id sequence
+        consistent and never interleave their startup pushes.
+
         ``local_only`` marks a rank-private pool (nested pools spawned by
         recursive task bodies, ``runtime/recursive.py``): it gets a local
         termination detector and NO comm id, so it never participates in
         the wire protocol and ranks may enqueue different numbers of them
         without desynchronizing the rank-agreed taskpool id sequence."""
+        with self._submit_lock:
+            self._add_taskpool_locked(tp, local_only)
+
+    def _add_taskpool_locked(self, tp: Taskpool, local_only: bool) -> None:
         tp.context = self
         tp.local_only = local_only = tp.local_only or local_only
         pins.fire(PinsEvent.TASKPOOL_INIT, None, tp)
@@ -193,7 +214,8 @@ class Context:
                 tp.comm_id = None
             else:
                 self.taskpool_list.append(tp)
-                tp.comm_id = len(self.taskpool_list)
+                self._next_comm_id += 1
+                tp.comm_id = self._next_comm_id
                 self._tp_by_comm_id[tp.comm_id] = tp
         if tp.on_enqueue is not None:
             tp.on_enqueue(tp)
@@ -232,6 +254,23 @@ class Context:
             if self._worker_error is None:
                 self._worker_error = e
             self._cond.notify_all()
+            listeners = list(self._failure_listeners)
+        for cb in listeners:            # outside the lock: a listener may
+            try:                        # fail tickets / take its own locks
+                cb(e)
+            except Exception:
+                pass        # diagnostics must never mask the poison
+
+    def add_failure_listener(
+            self, cb: Callable[[BaseException], None]) -> None:
+        """Observe context poison (the serving layer fails its in-flight
+        tickets from here).  Fires immediately if already poisoned."""
+        with self._lock:
+            err = self._worker_error
+            if err is None:
+                self._failure_listeners.append(cb)
+                return
+        cb(err)
 
     def start(self) -> None:
         """``parsec_context_start``: open the barrier, wake the comm thread."""
@@ -253,9 +292,31 @@ class Context:
         with self._cond:
             self._cond.notify_all()
 
-    def test(self) -> bool:
+    def test(self, tp: Taskpool | None = None) -> bool:
+        """``parsec_context_test`` — with ``tp``, the per-taskpool probe
+        (``parsec_taskpool_test``): one submission's completion can be
+        checked without asking about the whole context."""
+        if tp is not None:
+            return tp.test()
         with self._lock:
             return not self._active_taskpools
+
+    def _live_desc(self, limit: int = 8) -> str:
+        """Name the still-live taskpools (with their termdet counters) for
+        timeout messages and stall-dump reasons — a serving context holds
+        many concurrent pools and 'context wait timed out' alone says
+        nothing about WHICH submission wedged."""
+        with self._lock:
+            pools = list(self._active_taskpools)
+        if not pools:
+            return "no live taskpools"
+        parts = []
+        for tp in pools[:limit]:
+            nb = tp.tdm.snapshot()["nb_tasks"] if tp.tdm is not None \
+                else "?"
+            parts.append(f"{tp.name}[nb_tasks={nb}]")
+        more = f" +{len(pools) - limit} more" if len(pools) > limit else ""
+        return f"{len(pools)} live taskpools: " + ", ".join(parts) + more
 
     def wait(self, timeout: float | None = None) -> None:
         """``parsec_context_wait``: block until every taskpool completes.
@@ -268,7 +329,25 @@ class Context:
         try:
             self._drive_until(self.test, timeout)
         except ContextWaitTimeout:
-            self._stall_dump(f"context wait timed out (timeout={timeout}s)")
+            self._stall_dump(f"context wait timed out (timeout={timeout}s; "
+                             f"{self._live_desc()})")
+            raise
+
+    def wait_taskpool(self, tp: Taskpool,
+                      timeout: float | None = None) -> None:
+        """Block until ONE taskpool completes — ``parsec_taskpool_wait``
+        driven through the context, so a single live submission can be
+        awaited without draining everything else.  Deadline expiry raises
+        :class:`ContextWaitTimeout` (after the stall dump), naming the
+        awaited pool and every still-live one."""
+        if not self.started:
+            self.start()
+        try:
+            self._drive_until(tp.test, timeout)
+        except ContextWaitTimeout:
+            self._stall_dump(
+                f"taskpool {tp.name} wait timed out (timeout={timeout}s; "
+                f"{self._live_desc()})")
             raise
 
     def _stall_dump(self, reason: str) -> dict | None:
@@ -425,7 +504,8 @@ class Context:
                     rem = None if deadline is None else \
                         deadline - time.monotonic()
                     if rem is not None and rem <= 0:
-                        raise ContextWaitTimeout("context wait timed out")
+                        raise ContextWaitTimeout(
+                            "context wait timed out; " + self._live_desc())
                     # wake on termination, worker error, or a freshly
                     # enqueued compiled-DAG pool needing this driver
                     ok = self._cond.wait_for(
@@ -433,7 +513,8 @@ class Context:
                         or self._worker_error is not None
                         or self._has_pending_dag(), rem)
                     if not ok:
-                        raise ContextWaitTimeout("context wait timed out")
+                        raise ContextWaitTimeout(
+                            "context wait timed out; " + self._live_desc())
         self._run_compiled_dags(deadline=deadline)
         es = self._submit_es
         es.owner_ident = threading.get_ident()
@@ -445,7 +526,8 @@ class Context:
                 raise RuntimeError(
                     "a background thread failed") from self._worker_error
             if deadline is not None and time.monotonic() > deadline:
-                raise ContextWaitTimeout("context wait timed out")
+                raise ContextWaitTimeout(
+                    "context wait timed out; " + self._live_desc())
             try:
                 task, distance = select_task(es)
                 if task is None:
@@ -513,7 +595,8 @@ class Context:
                 # waiting on another pool's progress.  The pool stays
                 # pending and resumable either way.
                 if deadline is not None and time.monotonic() > deadline:
-                    raise ContextWaitTimeout("context wait timed out")
+                    raise ContextWaitTimeout(
+                        "context wait timed out; " + self._live_desc())
                 continue
             tp._compiled_dag = None
             tp.tdm.taskpool_addto_nb_tasks(-dag.ntasks)
@@ -523,6 +606,14 @@ class Context:
         with self._lock:
             if tp in self._active_taskpools:
                 self._active_taskpools.remove(tp)
+            if self.comm_engine is None and tp.comm_id is not None:
+                # long-lived (serving) contexts must not accumulate every
+                # pool they ever ran; without a comm engine nothing can
+                # look a terminated pool up by comm id again.  With one,
+                # pools stay registered (late wire messages may resolve).
+                self._tp_by_comm_id.pop(tp.comm_id, None)
+                if tp in self.taskpool_list:
+                    self.taskpool_list.remove(tp)
             self._cond.notify_all()
         # reclaim any dep-tracker state the taskpool left behind (nothing in
         # the normal case; an aborted pool would otherwise leak stashed

@@ -415,6 +415,11 @@ def compile_taskpool_dag(tp, context) -> CompiledDag | None:
     """Compile ``tp`` for the native DAG executor, or None (run dynamic)."""
     if not _params.get("runtime_dag_compile"):
         return None
+    # serving-layer opt-out (serve/server.py): a compiled pool is funneled
+    # whole by one claiming driver, which would bypass the weighted-fair
+    # scheduler's per-task tenant interleaving
+    if getattr(tp, "_serve_no_dag", False):
+        return None
     # multi-rank release goes through remote_dep — but rank-private nested
     # pools are single-rank by construction and stay eligible
     if getattr(context, "nb_ranks", 1) > 1 and not tp.local_only:

@@ -98,7 +98,13 @@ def schedule_tasks(es: ExecutionStream, tasks: list[Task],
     h = _hooks[_SCHEDULE_BEGIN]
     if h is not None:
         h(es, tasks)
-    keep = _params.get("runtime_keep_highest_priority_task")
+    scheduler = es.context.scheduler
+    # a strict-order scheduler (the serving layer's weighted-fair shim,
+    # serve/fair.py) owns the GLOBAL dispatch order: the keep-hot bypass
+    # would let a completed task's successor jump every other tenant's
+    # queue, so fairness wins over the one-task locality slot
+    keep = not getattr(scheduler, "strict_order", False) \
+        and _params.get("runtime_keep_highest_priority_task")
     # next_task is a single-owner slot: only the thread running this stream's
     # hot loop may touch it (a device manager or comm thread completing a
     # task on behalf of another stream must go through the scheduler)
@@ -107,7 +113,7 @@ def schedule_tasks(es: ExecutionStream, tasks: list[Task],
         tasks.sort(key=lambda t: t.priority)
         es.next_task = tasks.pop()  # highest priority stays hot
     if tasks:
-        es.context.scheduler.schedule(es, tasks, distance)
+        scheduler.schedule(es, tasks, distance)
     h = _hooks[_SCHEDULE_END]
     if h is not None:
         h(es, tasks)

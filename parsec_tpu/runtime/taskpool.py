@@ -52,6 +52,11 @@ class Taskpool:
         self._sim_lock = threading.Lock()
         self.largest_simulation_date = 0.0
         self._done = threading.Event()
+        # extra completion observers (serving tickets, drains): unlike the
+        # single on_complete slot these stack, and a listener added after
+        # termination fires immediately — no completion can be missed
+        self._completion_listeners: list[Callable[["Taskpool"], None]] = []
+        self._listeners_lock = threading.Lock()
         self.priority = 0
         _registry.insert(self.taskpool_id, self)
 
@@ -80,10 +85,26 @@ class Taskpool:
         ``nb_local_tasks_fn``); -1 means unknown (dynamic/DTD)."""
         return -1
 
+    def add_completion_listener(self, cb: Callable[["Taskpool"], None]
+                                ) -> None:
+        """Register an extra termination observer.  Fires exactly once;
+        immediately when the pool already terminated (the add/terminate
+        race is closed under ``_listeners_lock``)."""
+        with self._listeners_lock:
+            if not self._done.is_set():
+                self._completion_listeners.append(cb)
+                return
+        cb(self)
+
     def terminated(self) -> None:
-        self._done.set()
+        with self._listeners_lock:
+            self._done.set()
+            listeners = self._completion_listeners
+            self._completion_listeners = []
         if self.on_complete is not None:
             self.on_complete(self)
+        for cb in listeners:
+            cb(self)
         if self.context is not None:
             self.context._taskpool_terminated(self)
 

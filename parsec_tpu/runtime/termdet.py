@@ -80,6 +80,23 @@ class TermDetMonitor:
             return True
         return False
 
+    # -- observers (serving layer, stall diagnostics) -----------------------
+    def is_terminated(self) -> bool:
+        with self._lock:
+            return self.state == STATE_TERMINATED
+
+    def snapshot(self) -> dict:
+        """Consistent (state, counters) read for diagnostics — the stall
+        dump and the serving layer name live taskpools with these numbers
+        and must not observe a torn nb_tasks/state pair mid-update."""
+        with self._lock:
+            return {
+                "state": ("NOT_READY", "BUSY", "IDLE",
+                          "TERMINATED")[self.state],
+                "nb_tasks": self.nb_tasks,
+                "nb_pending_actions": self.nb_pending_actions,
+            }
+
     # comm-message counters: no-ops except for distributed detectors
     def on_comm_sent(self) -> None:
         pass

@@ -597,6 +597,38 @@ def _mk_component(mod_cls: type, prio: int) -> None:
     _C.__name__ = f"Sched{mod_cls.name.upper()}Component"
 
 
+@component
+class SchedServeFairComponent(Component):
+    """``--mca sched serve_fair`` / ``Context(scheduler="serve_fair")``:
+    a context built with the serving layer's weighted-fair shim
+    (serve/fair.py) pre-installed around whichever module wins the normal
+    priority query.  Fairness applies only to tasks of pools carrying a
+    serve submission — i.e. this exists to hand a pre-shimmed context to
+    ``RuntimeServer(context=...)`` (which then reuses it instead of
+    stacking a second shim); pools enqueued outside a server delegate
+    straight through to the inner module and are dispatched FIRST.
+    Explicit request only: the shim taxes schedule/select with a fairness
+    probe, so it must never win a default query."""
+
+    type_name = "sched"
+    name = "serve_fair"
+    priority = 0
+
+    def query(self, context: Any = None) -> bool:
+        return False
+
+    def open(self, context: Any = None) -> SchedulerModule:
+        from ..core.mca import repository
+        from ..serve.fair import FairScheduler
+        # best-priority inner by direct scan (not repository.query: the
+        # sched MCA param may name serve_fair itself, which would recurse)
+        for c in repository.components_of_type("sched"):
+            if c is not self and c.query(context):
+                return FairScheduler(c.open(context))
+        raise LookupError("serve_fair: no inner sched component accepts "
+                          "this context")
+
+
 _mk_component(LFQModule, 20)
 _mk_component(SPQModule, 18 - 6)   # spq=12 in the reference
 _mk_component(APModule, 12)

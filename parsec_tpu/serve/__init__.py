@@ -1,0 +1,12 @@
+"""The persistent serving layer: a long-lived runtime server with
+concurrent taskpool submission, admission control, and fair scheduling
+(``docs/SERVING.md``)."""
+
+from .admission import (AdmissionController, AdmissionRejected,
+                        DeadlineExceeded, TicketCancelled)
+from .fair import FairScheduler
+from .server import RuntimeServer, Ticket
+
+__all__ = ["RuntimeServer", "Ticket", "FairScheduler",
+           "AdmissionController", "AdmissionRejected", "DeadlineExceeded",
+           "TicketCancelled"]

@@ -1,0 +1,186 @@
+"""Weighted-fair scheduling shim above the sched module zoo.
+
+Batch contexts enqueue one DAG and drain it; dispatch order barely
+matters.  A serving context holds MANY live taskpools from competing
+tenants, and the stock modules (``sched/modules.py``) dispatch in
+pure arrival order — one tenant's large submission starves everyone
+behind it.  :class:`FairScheduler` wraps the context's real scheduler
+module and interposes only on tasks that belong to a serve submission
+(``taskpool._serve_sub`` set by ``serve/server.py``):
+
+- **across tenants**: weighted fair queueing — each tenant carries a
+  virtual time advanced by ``1/weight`` per dispatched task; select
+  serves the active tenant with the smallest virtual time, so long-run
+  dispatch shares converge to the weight ratio under saturation;
+- **within a tenant**: submission priority first (higher first), then
+  earliest deadline, then task priority, then arrival order.
+
+Tasks from non-serve pools (and every scheduler-module contract call)
+delegate to the wrapped inner module untouched, so the shim composes
+with any of the eleven schedulers — and select() drains the inner module
+FIRST: in a serving context the inner holds only non-submission work,
+chiefly the nested ``local_only`` pools a serve task body spawns, whose
+parent submission already holds an admission slot and a deadline
+(fair-queue-first would invert priority against the parent).  ``strict_order`` tells the runtime
+hot loop to skip the keep-hot ``next_task`` bypass (``scheduling.py``)
+— a released successor must not jump every other tenant's queue.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Any, Sequence
+
+from ..core.params import params as _params
+from ..sched.api import SchedulerModule
+
+_params.register("serve_fair_default_weight", 1.0,
+                 "fair-share weight for tenants without an explicit one")
+
+_INF = float("inf")
+
+
+class _TenantState:
+    __slots__ = ("name", "weight", "vtime", "heap")
+
+    def __init__(self, name: str, weight: float) -> None:
+        self.name = name
+        self.weight = max(weight, 1e-9)
+        self.vtime = 0.0
+        self.heap: list = []
+
+
+class FairScheduler(SchedulerModule):
+    name = "serve_fair"
+    strict_order = True     # scheduling.py: no keep-hot bypass around us
+
+    def __init__(self, inner: SchedulerModule) -> None:
+        self.inner = inner
+        self._lock = threading.Lock()
+        # only tenants with QUEUED work live here: states are evicted the
+        # moment their heap drains, so the per-select min() scan and the
+        # state footprint are bounded by concurrently-backlogged tenants,
+        # not by every tenant name the server ever saw (the million-user
+        # serving shape).  Eviction loses nothing: _vclock >= a served
+        # tenant's vtime, and reactivation clamps vtime to _vclock anyway.
+        self._tenants: dict[str, _TenantState] = {}
+        self._weights: dict[str, float] = {}    # persists across evictions
+        self._seq = itertools.count()
+        self._nfair = 0         # GIL-atomic fast-path emptiness probe
+        self._vclock = 0.0
+        self.dispatched: dict[str, int] = {}    # per-tenant tallies
+
+    # -- lifecycle: delegate; attach() when the inner is already live ----
+    def install(self, context: Any) -> None:
+        self.inner.install(context)
+
+    def attach(self, context: Any) -> None:
+        """No-op hook for wrapping an inner module that ``Context`` has
+        already installed and flow_init-ed (the server wraps after
+        construction, before ``start()`` opens the worker barrier)."""
+
+    def flow_init(self, es: Any) -> None:
+        self.inner.flow_init(es)
+
+    def set_weight(self, tenant: str, weight: float) -> None:
+        with self._lock:
+            self._weights[tenant] = max(weight, 1e-9)
+            ts = self._tenants.get(tenant)
+            if ts is not None:
+                ts.weight = self._weights[tenant]
+
+    def _state_locked(self, tenant: str) -> _TenantState:
+        ts = self._tenants.get(tenant)
+        if ts is None:
+            ts = _TenantState(tenant, self._weights.get(
+                tenant, _params.get("serve_fair_default_weight")))
+            self._tenants[tenant] = ts
+        return ts
+
+    # -- the scheduler contract -----------------------------------------
+    def schedule(self, es: Any, tasks: Sequence[Any],
+                 distance: int = 0) -> None:
+        fair = None
+        plain = None
+        for t in tasks:
+            sub = getattr(t.taskpool, "_serve_sub", None)
+            if sub is None:
+                if plain is None:
+                    plain = []
+                plain.append(t)
+            else:
+                if fair is None:
+                    fair = []
+                fair.append((sub, t))
+        if plain:
+            self.inner.schedule(es, plain, distance)
+        if fair:
+            with self._lock:
+                for sub, t in fair:
+                    ts = self._state_locked(sub.tenant)
+                    if not ts.heap:
+                        # (re)activation: clamp to the system virtual
+                        # clock so an idle tenant cannot bank credit and
+                        # burst past active ones (standard WFQ)
+                        ts.vtime = max(ts.vtime, self._vclock)
+                    heapq.heappush(ts.heap, (
+                        (-sub.priority,
+                         sub.deadline_at if sub.deadline_at is not None
+                         else _INF,
+                         -(t.priority or 0),
+                         next(self._seq)),
+                        t))
+                self._nfair += len(fair)
+
+    def select(self, es: Any) -> tuple[Any | None, int]:
+        # INNER first: in a serving context the inner module holds only
+        # non-submission work — above all the nested local_only pools a
+        # serve task body spawns (runtime/recursive.py), whose parent
+        # submission already holds an admission slot and a deadline.
+        # Serving the fair queues first would starve that nested work
+        # behind every other tenant: priority inversion against its own
+        # parent.  Finish what's started, then share what's queued.
+        t, d = self.inner.select(es)
+        if t is not None:
+            return t, d
+        if self._nfair:
+            with self._lock:
+                active = [ts for ts in self._tenants.values() if ts.heap]
+                if active:
+                    ts = min(active, key=lambda s: s.vtime)
+                    _, task = heapq.heappop(ts.heap)
+                    ts.vtime += 1.0 / ts.weight
+                    self._vclock = max(self._vclock, ts.vtime)
+                    self._nfair -= 1
+                    self.dispatched[ts.name] = \
+                        self.dispatched.get(ts.name, 0) + 1
+                    if not ts.heap:
+                        del self._tenants[ts.name]   # bounded state/scan
+                    return task, 0
+        return None, 0
+
+    def remove(self, context: Any) -> None:
+        with self._lock:
+            self._tenants.clear()
+            self._nfair = 0
+        self.inner.remove(context)
+
+    def pending_tasks(self, context: Any) -> int:
+        return self._nfair + self.inner.pending_tasks(context)
+
+    def dispatch_counts(self) -> dict[str, int]:
+        """Locked snapshot of per-tenant dispatch tallies — ``dispatched``
+        grows new tenant keys under ``_lock``, so an unlocked dict() copy
+        can die mid-resize."""
+        with self._lock:
+            return dict(self.dispatched)
+
+    def queue_depths(self, context: Any) -> dict[str, int]:
+        out = dict(self.inner.queue_depths(context))
+        with self._lock:
+            for name, ts in self._tenants.items():
+                if ts.heap:
+                    out[f"fair.{name}"] = len(ts.heap)
+        return out

@@ -1,0 +1,492 @@
+"""The persistent runtime server: a long-lived hot Context serving
+concurrent DAG submissions.
+
+A batch :class:`~parsec_tpu.runtime.context.Context` runs enqueue →
+start → wait → fini once; the process pays worker spin-up, scheduler
+install, and (dominantly) lowering/compile on every request.  The ROADMAP
+north star is the opposite shape — a resident runtime absorbing a stream
+of independent DAG requests from many clients (MPK, arxiv 2512.22219,
+makes the same amortize-over-a-resident-runtime argument) — and the PR-2
+persistent lowering cache (warm ~0.4 ms vs ~130 ms cold) only pays off
+when the process outlives a single DAG.
+
+:class:`RuntimeServer` keeps one Context's workers running and gives
+every client thread::
+
+    server = RuntimeServer(nb_cores=2, tenant_weights={"pro": 4.0})
+    ticket = server.submit(taskpool, tenant="pro", priority=1,
+                           deadline=0.5)
+    result = ticket.result(timeout=30)     # this submission only
+    server.drain(timeout=60)               # stop admitting, finish, fini
+
+Pieces:
+
+- **Ticket** — per-submission completion promise over ``core/future.py``
+  (``result() / done() / cancel()``), resolved by per-taskpool
+  termination detection (``runtime/termdet.py``) — no context drain.
+- **Admission** — :class:`~parsec_tpu.serve.admission.AdmissionController`
+  budgets (MCA params), blocking backpressure or typed shed.
+- **Fairness** — :class:`~parsec_tpu.serve.fair.FairScheduler` wraps the
+  context's scheduler: weighted tenant share + priority + deadline
+  instead of arrival order.
+- **Observability** — every stage fires a ``SERVE_*`` PINS event, so the
+  flight recorder, stall dumps, and ``prof.export_run_report()`` cover
+  serving with zero extra wiring (``docs/SERVING.md``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from ..core.future import Future
+from ..core.params import params as _params
+from ..prof import pins
+from ..prof.pins import PinsEvent
+from ..runtime.context import Context, ContextWaitTimeout
+from ..runtime.taskpool import Taskpool
+from .admission import (AdmissionController, AdmissionRejected,
+                        DeadlineExceeded, TicketCancelled)
+from .fair import FairScheduler
+
+_params.register("serve_num_cores", 2,
+                 "worker threads a RuntimeServer's context runs with "
+                 "(serving requires >= 1: clients block on tickets, not "
+                 "on driving progress)")
+
+
+class _Submission:
+    """The per-submission record the fair scheduler keys on
+    (``taskpool._serve_sub``)."""
+
+    __slots__ = ("tenant", "priority", "deadline_at", "cost", "ticket",
+                 "result_fn", "released")
+
+    def __init__(self, tenant: str, priority: int,
+                 deadline_at: float | None, cost: int,
+                 ticket: "Ticket",
+                 result_fn: Callable[[Taskpool], Any] | None) -> None:
+        self.tenant = tenant
+        self.priority = priority
+        self.deadline_at = deadline_at
+        self.cost = cost
+        self.ticket = ticket
+        self.result_fn = result_fn
+        self.released = False       # admission released exactly once
+
+
+class Ticket:
+    """A submission's handle: state, timing, and a single-assignment
+    result future.  States walk ``queued`` → ``running`` → ``done`` /
+    ``failed``, or end early at ``rejected`` / ``cancelled``."""
+
+    def __init__(self, server: "RuntimeServer", name: str, tenant: str,
+                 priority: int, deadline_at: float | None) -> None:
+        self._server = server
+        self.name = name
+        self.tenant = tenant
+        self.priority = priority
+        self.deadline_at = deadline_at
+        self.state = "queued"
+        self.deadline_missed = False
+        self.submitted_at = time.monotonic()
+        self.completed_at: float | None = None
+        self._future: Future = Future()
+        self._slock = threading.Lock()
+        self._settled = False
+        self._cancelled = False
+
+    # -- client API ------------------------------------------------------
+    def result(self, timeout: float | None = None) -> Any:
+        """Block for THIS submission's completion (the context keeps
+        serving others).  Raises the stored failure for failed/rejected/
+        cancelled tickets; ``TimeoutError`` on deadline."""
+        kind, v = self._future.get(timeout)
+        if kind == "err":
+            raise v
+        return v
+
+    def done(self) -> bool:
+        return self._future.is_ready()
+
+    def cancel(self) -> bool:
+        """Cancel while still queued for admission.  Returns ``True`` when
+        the cancellation will take effect; ``False`` once the submission
+        started executing (a live DAG cannot be safely unpicked from the
+        dependence trackers) or already finished."""
+        with self._slock:
+            if self._settled:
+                return self.state == "cancelled"
+            if self.state != "queued":
+                return False
+            self._cancelled = True
+        self._server._adm.kick()
+        return True
+
+    @property
+    def latency_s(self) -> float | None:
+        if self.completed_at is None:
+            return None
+        return self.completed_at - self.submitted_at
+
+    # -- settlement (exactly once) --------------------------------------
+    def _commit_start(self) -> bool:
+        """The queued → running transition, serialized against
+        :meth:`cancel` under ``_slock``: exactly one of them wins.  False
+        = a cancel landed first and the submission must shed."""
+        with self._slock:
+            if self._cancelled or self._settled:
+                return False
+            self.state = "running"
+            return True
+
+    def _resolve(self, value: Any) -> bool:
+        """Returns True iff THIS call settled the ticket — settlement is
+        exactly-once, and the caller that wins owns the stats count."""
+        with self._slock:
+            if self._settled:
+                return False
+            self._settled = True
+            self.state = "done"
+        self.completed_at = time.monotonic()
+        if self.deadline_at is not None and \
+                self.completed_at > self.deadline_at:
+            self.deadline_missed = True
+        self._future.set(("ok", value))
+        return True
+
+    def _fail(self, exc: BaseException, state: str = "failed") -> bool:
+        with self._slock:
+            if self._settled:
+                return False
+            self._settled = True
+            self.state = state
+        self.completed_at = time.monotonic()
+        self._future.set(("err", exc))
+        return True
+
+
+class RuntimeServer:
+    """A resident runtime accepting concurrent taskpool submissions.
+
+    Construction starts the context's workers immediately; the server is
+    hot until :meth:`drain`.  Usable as a context manager (``__exit__``
+    drains)."""
+
+    def __init__(self, nb_cores: int | None = None,
+                 scheduler: str | None = None,
+                 tenant_weights: dict[str, float] | None = None,
+                 admission: AdmissionController | None = None,
+                 context: Context | None = None) -> None:
+        if context is not None:
+            self._ctx = context
+        else:
+            if nb_cores is None:
+                nb_cores = _params.get("serve_num_cores")
+            self._ctx = Context(nb_cores=nb_cores, scheduler=scheduler)
+        if self._ctx.nb_cores < 1:
+            raise ValueError(
+                "RuntimeServer needs a context with worker threads "
+                "(nb_cores >= 1): clients block on tickets, nobody "
+                "drives a caller-driven context")
+        # interpose the fair shim before the workers pass the start
+        # barrier — they resolve context.scheduler per select call.  A
+        # context built with ``scheduler="serve_fair"`` (the MCA-exposed
+        # shim, sched/modules.py) already has one: reuse, never stack.
+        if isinstance(self._ctx.scheduler, FairScheduler):
+            self._fair = self._ctx.scheduler
+        else:
+            self._fair = FairScheduler(self._ctx.scheduler)
+            self._fair.attach(self._ctx)
+            self._ctx.scheduler = self._fair
+        for tenant, w in (tenant_weights or {}).items():
+            self._fair.set_weight(tenant, w)
+        self._adm = admission if admission is not None \
+            else AdmissionController()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._inflight: set[Ticket] = set()
+        self._draining = False
+        self._drained = threading.Event()
+        self._poison: BaseException | None = None
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.rejected = 0
+        self.per_tenant_completed: dict[str, int] = {}
+        self._ctx.add_failure_listener(self._on_context_failure)
+        self._ctx.start()
+
+    # -- submission ------------------------------------------------------
+    def submit(self, tp: Taskpool, *, tenant: str = "default",
+               priority: int = 0, deadline: float | None = None,
+               block: bool = True, compiled: bool = False,
+               result_fn: Callable[[Taskpool], Any] | None = None
+               ) -> Ticket:
+        """Submit one taskpool; returns its :class:`Ticket`.
+
+        ``priority`` ranks within the tenant (higher first);
+        ``deadline`` is a relative budget in seconds — expiry while
+        *queued for admission* sheds (:class:`DeadlineExceeded`), expiry
+        after start only flags ``ticket.deadline_missed``.  ``block``
+        picks backpressure (wait for budget, bounded by
+        ``serve_admission_timeout``) vs immediate shed.  ``result_fn(tp)``
+        computes the ticket's value at completion (default: the taskpool
+        itself — read your collections off it).
+
+        Served pools run the DYNAMIC scheduler path by default so the
+        weighted-fair shim interleaves tenants at task grain;
+        ``compiled=True`` opts back into the funneled compiled-DAG
+        executor (lowest per-task overhead, but the whole pool dispatches
+        as one fairness-opaque unit)."""
+        deadline_at = None if deadline is None \
+            else time.monotonic() + deadline
+        ticket = Ticket(self, tp.name, tenant, priority, deadline_at)
+        pins.fire(PinsEvent.SERVE_SUBMIT, None, (tenant, tp.name))
+        with self._lock:
+            self.submitted += 1
+            closed = self._draining or self._poison is not None
+        cost = 1
+        if self._adm.max_inflight_tasks:
+            n = tp.nb_local_tasks()
+            cost = n if n > 0 else _params.get("serve_default_task_cost")
+        try:
+            if closed:
+                raise AdmissionRejected(
+                    "server is draining" if self._poison is None
+                    else "server context is poisoned")
+            self._adm.admit(tenant, cost, block=block,
+                            deadline_at=deadline_at,
+                            cancelled=lambda: ticket._cancelled)
+        except AdmissionRejected as e:
+            pins.fire(PinsEvent.SERVE_REJECT, None, (tenant, tp.name))
+            with self._lock:
+                self.rejected += 1
+            ticket._fail(e, state="cancelled"
+                         if isinstance(e, TicketCancelled) else "rejected")
+            raise
+        pins.fire(PinsEvent.SERVE_ADMIT, None, (tenant, tp.name))
+        sub = _Submission(tenant, priority, deadline_at, cost, ticket,
+                          result_fn)
+        tp._serve_sub = sub
+        if not compiled:
+            tp._serve_no_dag = True     # dagrun.compile_taskpool_dag gate
+        # check-and-register atomically: a drain that began while this
+        # thread sat inside admit() must either see the ticket in flight
+        # (and wait for it) or shed it here — never tear the context down
+        # under a submission registering concurrently.  The queued →
+        # running commit also happens BEFORE enqueue and is serialized
+        # against cancel(): a cancel() that returned True can never see
+        # its submission execute anyway.
+        started = ticket._commit_start()
+        with self._lock:
+            closed = self._draining or self._poison is not None
+            if started and not closed:
+                self._inflight.add(ticket)
+            else:
+                self.rejected += 1
+        if not started or closed:
+            self._adm.release(tenant, cost)
+            pins.fire(PinsEvent.SERVE_REJECT, None, (tenant, tp.name))
+            e: AdmissionRejected = TicketCancelled(
+                "ticket cancelled before start") if not started \
+                else AdmissionRejected("server is draining")
+            ticket._fail(e, state="cancelled" if not started
+                         else "rejected")
+            raise e
+        # listener BEFORE enqueue: a trivial pool may terminate inside
+        # add_taskpool and must still resolve the ticket.  START fires
+        # before enqueue for the same reason — a synchronously-completing
+        # pool must record SUBMIT → ADMIT → START → COMPLETE in order
+        pins.fire(PinsEvent.SERVE_START, None, (tenant, tp.name))
+        tp.add_completion_listener(self._on_pool_done)
+        try:
+            self._ctx.add_taskpool(tp)
+        except BaseException as e:
+            # exactly-once release: the pool may have gone live before the
+            # exception, in which case _on_pool_done will still fire at
+            # termination — it must not release the budget a second time
+            self._release_once(sub)
+            with self._lock:
+                self._inflight.discard(ticket)
+                self.rejected += 1
+                self._cond.notify_all()
+            pins.fire(PinsEvent.SERVE_REJECT, None, (tenant, tp.name))
+            ticket._fail(e, state="rejected")
+            raise
+        return ticket
+
+    def _release_once(self, sub: _Submission) -> bool:
+        """Release a submission's admission budget exactly once — the
+        failed-enqueue path and the completion listener can both reach
+        it, and a double release would silently loosen the high-water
+        marks for the server's lifetime."""
+        with self._lock:
+            if sub.released:
+                return False
+            sub.released = True
+        self._adm.release(sub.tenant, sub.cost)
+        return True
+
+    def submit_lowered(self, tp: Taskpool, **kw: Any) -> Ticket:
+        """Submit a PTG pool through the **compiled** incarnation: the
+        request executes as one ``lower_taskpool(tp).jitted()`` call on a
+        worker thread, and the ticket resolves to the output stores (a
+        ``{name: np.ndarray}`` dict).  Repeat submissions of a
+        structurally identical class hit the process-wide PR-2
+        ``lowering_cache`` and skip trace+compile entirely — the warm
+        path that makes a resident server worth keeping hot."""
+        import numpy as np
+
+        from .. import ptg as _ptg
+
+        out: dict[str, Any] = {}
+        p = _ptg.PTGBuilder(f"lowered:{tp.name}")
+        t = p.task("RUN", i=_ptg.span(0, lambda g, l: 0))
+        t.flow("ctl", _ptg.CTL)
+
+        def body(es: Any, task: Any, g: Any, l: Any) -> None:
+            from ..ptg.lowering import lower_taskpool
+            low = lower_taskpool(tp)
+            res = low.jitted()(low.initial_stores())
+            out["stores"] = {k: np.asarray(v) for k, v in res.items()}
+
+        t.body(body)
+        kw.setdefault("result_fn", lambda _tp: out["stores"])
+        return self.submit(p.build(), **kw)
+
+    # -- completion / failure -------------------------------------------
+    def _on_pool_done(self, tp: Taskpool) -> None:
+        sub: _Submission = tp._serve_sub
+        tp._serve_sub = None
+        if self._release_once(sub):
+            # only the releasing call announces completion: a pool whose
+            # enqueue path already shed (and released) must not add a
+            # spurious SERVE_COMPLETE for a submission reported rejected
+            pins.fire(PinsEvent.SERVE_COMPLETE, None, (sub.tenant, tp.name))
+        ok = False
+        try:
+            value = sub.result_fn(tp) if sub.result_fn is not None else tp
+        except BaseException as e:       # a result_fn bug fails ONE ticket
+            settled = sub.ticket._fail(e)
+        else:
+            settled = ok = sub.ticket._resolve(value)
+        with self._lock:
+            self._inflight.discard(sub.ticket)
+            # only the call that SETTLED the ticket counts it: one already
+            # failed by a drain timeout or a poison sweep completing late
+            # must not inflate failed (or completed) a second time
+            if ok:
+                self.completed += 1
+                self.per_tenant_completed[sub.tenant] = \
+                    self.per_tenant_completed.get(sub.tenant, 0) + 1
+            elif settled:
+                self.failed += 1
+            self._cond.notify_all()
+
+    def _on_context_failure(self, e: BaseException) -> None:
+        """Context poison (a worker died): fail every in-flight ticket so
+        no client blocks forever, and stop admitting."""
+        self._adm.close()
+        with self._lock:
+            self._poison = e
+            pending = list(self._inflight)
+            self._inflight.clear()
+            self._cond.notify_all()
+        nfailed = 0
+        for tk in pending:
+            err = RuntimeError(
+                f"runtime context failed while serving {tk.name!r}")
+            err.__cause__ = e
+            nfailed += tk._fail(err)    # a concurrently-resolving ticket
+        with self._lock:                # keeps its own (done) count
+            self.failed += nfailed
+
+    # -- lifecycle -------------------------------------------------------
+    def drain(self, timeout: float | None = None) -> None:
+        """Graceful shutdown: stop admitting, let in-flight submissions
+        finish, then ``fini`` the context.  On ``timeout`` expiry the
+        remaining tickets fail with :class:`ContextWaitTimeout` and the
+        context tears down abort-style (stall dump fires) — the server is
+        DOWN either way when this returns/raises."""
+        with self._lock:
+            first = not self._draining
+            self._draining = True
+        if not first:
+            # a concurrent drain owns the teardown: wait for IT to finish
+            # — returning on mere inflight-emptiness would hand back a
+            # server whose workers are still being joined
+            if not self._drained.wait(timeout):
+                raise ContextWaitTimeout(
+                    "concurrent drain still in progress")
+            return
+        self._adm.close()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: not self._inflight,
+                None if deadline is None
+                else max(0.0, deadline - time.monotonic()))
+            leftover = [] if ok else list(self._inflight)
+            # wedged submissions leave the books with their tickets: a
+            # stale inflight set would wedge every LATER drain() and lie
+            # in stats() forever
+            self._inflight.clear()
+        pins.fire(PinsEvent.SERVE_DRAIN, None,
+                  ("-", f"inflight={len(leftover)}"))
+        nfailed = 0
+        for tk in leftover:
+            nfailed += tk._fail(ContextWaitTimeout(
+                f"server drain timed out with {tk.name!r} still in flight"))
+        with self._lock:
+            self.failed += nfailed
+        rem = None if deadline is None \
+            else max(0.0, deadline - time.monotonic())
+        try:
+            self._ctx.fini(timeout=rem)
+        finally:
+            self._drained.set()     # the server is DOWN, success or not
+        if leftover:
+            raise ContextWaitTimeout(
+                f"server drain timed out ({len(leftover)} submissions "
+                f"still in flight)")
+
+    def __enter__(self) -> "RuntimeServer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        if exc[0] is None:
+            self.drain()
+        else:
+            # exception-path teardown: fail every in-flight ticket FIRST
+            # (abort() records no context poison, so no failure listener
+            # would fire) — a client blocked in result() must get a
+            # prompt server-shutdown error, not its own full timeout
+            self._on_context_failure(
+                exc[1] if exc[1] is not None
+                else RuntimeError("server aborted"))
+            with self._lock:
+                self._draining = True
+            self._ctx.abort()
+            self._drained.set()
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def context(self) -> Context:
+        return self._ctx
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "failed": self.failed,
+                "rejected": self.rejected,
+                "inflight": len(self._inflight),
+                "draining": self._draining,
+                "poisoned": self._poison is not None,
+                "per_tenant_completed": dict(self.per_tenant_completed),
+                "fair_dispatched": self._fair.dispatch_counts(),
+                "admission": self._adm.stats(),
+            }

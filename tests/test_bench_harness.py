@@ -103,6 +103,19 @@ def test_dynamic_stages_exercised_on_cpu(smoke_run):
     assert last["extra"]["dynamic_gemm_breakdown"].get("xla_calls", 0) > 0
 
 
+def test_serve_stage_reports_throughput_and_warm_cache(smoke_run):
+    """The serving stage (ISSUE 3) ships sustained submissions/s, ticket
+    latency percentiles, and the warm-vs-cold lowered split — and the
+    warm repeat class really skipped the compile."""
+    last = _json_lines(smoke_run[0].stdout)[-1]
+    sv = last["extra"]["serve"]
+    assert sv["serve_submits_per_s"] > 0
+    assert sv["serve_p50_ms"] > 0
+    assert sv["serve_p99_ms"] >= sv["serve_p50_ms"]
+    assert sv["serve_lowered_cache_hits"] >= 1
+    assert sv["serve_lowered_warm_s"] < sv["serve_lowered_cold_s"]
+
+
 def test_lowered_stages_report_compile_seconds(smoke_run):
     last = _json_lines(smoke_run[0].stdout)[-1]
     assert last["extra"]["lowered_cholesky_compile_s"] > 0
@@ -155,7 +168,7 @@ def test_every_stage_carries_runtime_report(smoke_run):
     p, _dt, _cwd = smoke_run
     last = _json_lines(p.stdout)[-1]
     reports = last["extra"]["runtime_reports"]
-    stage_names = {"dispatch", "gemm", "raw_dot", "stencil",
+    stage_names = {"dispatch", "gemm", "raw_dot", "serve", "stencil",
                    "lowered_cholesky", "lowered_stencil", "lowered_lu",
                    "dynamic_gemm", "dtd_gemm", "lowered_cholesky_16k",
                    "dynamic_cholesky"}

@@ -1,5 +1,6 @@
-"""The Ex00–Ex10 examples ladder is living documentation: every script
-must keep running and self-checking (reference examples/ + SURVEY §2.11)."""
+"""The Ex00–Ex11 examples ladder is living documentation: every script
+must keep running and self-checking (reference examples/ + SURVEY §2.11;
+Ex11 is the serving-layer demo, parsec_tpu/serve/)."""
 
 import importlib.util
 import pathlib
@@ -19,7 +20,7 @@ def load(path):
 
 def test_ladder_is_complete():
     assert [p.stem.split("_")[0] for p in EXAMPLES] == \
-        [f"Ex{i:02d}" for i in range(11)]
+        [f"Ex{i:02d}" for i in range(12)]
 
 
 @pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: p.stem)

@@ -26,6 +26,11 @@ RELEASE_TASKS_PER_S_MIN = 2000.0
 LOCAL_POP_US_MAX = 4.0
 STEAL_US_MAX = 10.0
 PINS_DISABLED_NS_MAX = 500.0
+# ISSUE-3 serving baseline: ~300-400 submissions/s, p50 ~4-6ms, p99 ~13ms
+# for 4 clients x tiny CTL pools on 2 workers (docs/SERVING.md) — same
+# ~10x headroom discipline
+SERVE_SUBMITS_PER_S_MIN = 25.0
+SERVE_P99_MS_MAX = 250.0
 
 
 def test_compiled_dispatch_latency():
@@ -52,6 +57,16 @@ def test_pins_disabled_site_cost():
     if r["pins_disabled_ns"] is None:
         pytest.skip("PINS chains registered; disabled site unmeasurable")
     assert r["pins_disabled_ns"] <= PINS_DISABLED_NS_MAX, r
+
+
+def test_serve_sustained_submission_throughput():
+    """The serving path (admission + fair queue + live enqueue + ticket)
+    must sustain concurrent submissions without a gross regression —
+    tier-1's guard on the RuntimeServer critical path."""
+    r = microbench.bench_serve(nsub=16, nthreads=4, depth=4)
+    assert r["serve_nsub"] == 16, r
+    assert r["serve_submits_per_s"] >= SERVE_SUBMITS_PER_S_MIN, r
+    assert r["serve_p99_ms"] <= SERVE_P99_MS_MAX, r
 
 
 def test_lowering_cache_warm_compile_is_near_zero():
