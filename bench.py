@@ -488,8 +488,25 @@ def bench_overhead() -> dict:
     platform = (os.environ.get("BENCH_PLATFORM")
                 or os.environ.get("JAX_PLATFORMS") or "")
     out = run_all(smoke=smoke, include_lowering=platform == "cpu",
-                  include_serve=False)   # the dedicated serve stage owns it
+                  include_serve=False,   # the dedicated serve stage owns it
+                  include_comm=False)    # ...and the comm stage likewise
     out["gflops"] = 0.0   # not a throughput stage; keep the stage shape
+    return out
+
+
+def bench_comm_stage() -> dict:
+    """The comm data-path stage (microbench.bench_comm): AM roundtrip
+    latency, coalesced activation throughput, GET GB/s per tier and
+    payload size, the pickled-framing baseline + speedup ratio, and
+    overlap efficiency during a saturating fragmented GET.  Pure
+    CPU+sockets — rides the always-first CPU-safe group with the
+    overhead stage, so the comm perf axis has numbers even when the
+    accelerator relay is dark (ISSUE 4)."""
+    import os
+
+    from microbench import bench_comm
+    out = bench_comm(smoke=os.environ.get("BENCH_SMOKE") == "1")
+    out["gflops"] = 0.0   # not a compute stage; keep the stage shape
     return out
 
 
@@ -743,6 +760,11 @@ def main() -> None:
                 "overhead": {k: v for k, v in
                              res.get("overhead", {}).items()
                              if k not in ("runtime_report", "gflops")},
+                # the comm wire-path stage: AM roundtrips, GET GB/s per
+                # tier/size, pickle-baseline speedup, overlap (ISSUE 4)
+                "comm": {k: v for k, v in
+                         res.get("comm", {}).items()
+                         if k not in ("runtime_report", "gflops")},
                 # the serving stage: submissions/s, ticket latency, and
                 # the warm-vs-cold lowered split (ISSUE 3)
                 "serve": {k: v for k, v in
@@ -833,6 +855,10 @@ def main() -> None:
     # touch the relay: dispatch/release/steal numbers land even when
     # every accelerator stage is dark (ISSUE 2 satellite) ---
     stage("overhead", bench_overhead, timeout=120.0, primary=True)
+    # --- the comm wire-path stage rides the same CPU-safe always-first
+    # group: AM latency, GET GB/s vs the pickle baseline, and overlap
+    # efficiency need only sockets (ISSUE 4) ---
+    stage("comm", bench_comm_stage, timeout=90.0, primary=True)
 
     # --- primary metrics next: a headline must land within minutes ---
     d = _staged("dispatch", bench_dispatch_us, timeout=90.0)

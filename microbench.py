@@ -22,7 +22,13 @@ measurable even when the TPU relay is dark:
   ptg/lowering.py);
 - ``bench_serve``              — sustained submissions/s and p50/p99
   ticket latency through a RuntimeServer: concurrent client threads,
-  two tenants, one hot context (the serving layer, parsec_tpu/serve/).
+  two tenants, one hot context (the serving layer, parsec_tpu/serve/);
+- ``bench_comm``               — the comm wire data path (ISSUE 4): AM
+  roundtrip µs over inproc + localhost sockets, coalesced compact
+  activations/s, one-sided GET GB/s at 64KiB/4MiB/64MiB through the
+  binary scatter-gather framing + windowed fragmented rendezvous, the
+  legacy pickle-framing baseline and speedup ratio, and the overlap
+  efficiency of compute retired during a saturating fragmented GET.
 
 ``python microbench.py`` prints one JSON object and finishes in seconds on a
 CPU-only host.  ``run_all(smoke=True)`` shrinks every config for CI; the
@@ -268,13 +274,225 @@ def bench_serve(nsub: int = 64, nthreads: int = 4, depth: int = 8,
     }
 
 
+def _comm_socket_pair():
+    """Two socket fabrics + engines in one process on a free localhost
+    port range (the oversubscribed two-rank DCN shape)."""
+    from parsec_tpu.comm.multiproc import _free_port_base
+    from parsec_tpu.comm.socket_fabric import SocketCommEngine, SocketFabric
+
+    base = _free_port_base(2)
+    f0 = SocketFabric(2, 0, base_port=base)
+    f1 = SocketFabric(2, 1, base_port=base)
+    return SocketCommEngine(f0), SocketCommEngine(f1)
+
+
+def _comm_wait(engines, pred, sleep_s: float = 0.0002,
+               timeout: float = 60.0) -> None:
+    """Progress all engines until ``pred()``; the tiny sleep yields the
+    GIL to the fabric receive threads (a hard spin would throttle them to
+    the interpreter's switch interval and measure the GIL, not the wire)."""
+    deadline = time.perf_counter() + timeout
+    while not pred():
+        for e in engines:
+            e.progress()
+        if sleep_s:
+            time.sleep(sleep_s)
+        if time.perf_counter() > deadline:
+            raise TimeoutError("comm bench wait timed out")
+
+
+def _comm_get_gbps(e0, e1, nbytes: int, reps: int) -> float:
+    """GET throughput rank1→rank0 for one payload size (warm wire)."""
+    import numpy as np
+    arr = np.random.default_rng(7).integers(
+        0, 255, size=max(nbytes, 1), dtype=np.uint8)
+    best = None
+    for _ in range(reps):
+        h = e1.mem_register(arr, refcount=1, owned=True)
+        done: list = []
+        t0 = time.perf_counter()
+        e0.get(h.wire(), done.append)
+        _comm_wait((e0, e1), lambda: done)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+        assert done[0].nbytes == arr.nbytes
+    return arr.nbytes / best / 1e9
+
+
+def bench_comm(smoke: bool = False) -> dict:
+    """The comm data-path numbers (CPU-provable, no accelerator):
+
+    - ``comm_am_roundtrip_us_*``      — ping-pong latency of one small AM
+      over the in-process fabric and over localhost sockets;
+    - ``comm_activations_per_s``      — coalesced compact-form activation
+      batches through the binary socket framing, decoded end to end;
+    - ``comm_get_*_gbps``             — one-sided GET throughput per tier
+      at 64KiB / 4MiB / 64MiB (socket payloads move as scatter-gather
+      binary frames, ≥4MiB as windowed fragments recv_into'd straight
+      into the destination buffer);
+    - ``comm_get_socket_pickle_gbps`` + ``comm_get_speedup_vs_pickle`` —
+      the same 4MiB socket GET over the legacy length-prefixed-pickle
+      framing (``comm_wire_binary=False``), the measured baseline the
+      zero-copy path is judged against (ISSUE 4 acceptance: ≥3×);
+    - ``comm_overlap_efficiency``     — fraction of a saturating 64MiB
+      fragmented GET's wall time the consumer spent retiring compute
+      (progress interleaved between compute units, the T3-style overlap).
+    """
+    import numpy as np
+
+    from parsec_tpu.comm.engine import AM_TAG_USER_BASE, InprocFabric
+    from parsec_tpu.core.params import params
+
+    out: dict = {}
+    reps = 3 if smoke else 5
+    # smoke keeps the 4MiB point: it is the acceptance size the pickle
+    # baseline is compared at, and the ratio there is wide enough
+    # (~4x idle) to stay unambiguous under CI load
+    sizes = ((65536, "64kib"), (4 << 20, "4mib")) if smoke else \
+        ((65536, "64kib"), (4 << 20, "4mib"), (64 << 20, "64mib"))
+    saved = {k: params.get(k) for k in
+             ("comm_wire_binary", "comm_get_frag_bytes", "comm_get_window")}
+    params.set("comm_wire_binary", True)
+    params.set("comm_get_frag_bytes", 1 << 20 if smoke else 4 << 20)
+    params.set("comm_get_window", 4)
+    try:
+        # -- AM roundtrip: inproc ------------------------------------------
+        fab = InprocFabric(2)
+        i0, i1 = fab.attach(0), fab.attach(1)
+        n_pp = 200 if smoke else 1000
+        count = [0]
+        i1.tag_register(AM_TAG_USER_BASE, lambda eng, src, p:
+                        i1.send_am(AM_TAG_USER_BASE, src, p))   # echo
+        i0.tag_register(AM_TAG_USER_BASE, lambda eng, src, p:
+                        count.__setitem__(0, count[0] + 1))     # pong
+        t0 = time.perf_counter()
+        for _ in range(n_pp):
+            want = count[0] + 1
+            i0.send_am(AM_TAG_USER_BASE, 1, {"seq": 1})
+            _comm_wait((i0, i1), lambda w=want: count[0] >= w, sleep_s=0)
+        out["comm_am_roundtrip_us_inproc"] = round(
+            (time.perf_counter() - t0) / n_pp * 1e6, 2)
+
+        # -- AM roundtrip + activation batches: localhost sockets ----------
+        e0, e1 = _comm_socket_pair()
+        pong = [0]
+        e0.tag_register(AM_TAG_USER_BASE, lambda eng, src, p:
+                        pong.__setitem__(0, pong[0] + 1))
+        e1.tag_register(AM_TAG_USER_BASE, lambda eng, src, p:
+                        e1.send_am(AM_TAG_USER_BASE, src, p))
+        n_pp = 50 if smoke else 200
+        # warm the duplex connections first
+        e0.send_am(AM_TAG_USER_BASE, 1, 0)
+        _comm_wait((e0, e1), lambda: pong[0] == 1)
+        t0 = time.perf_counter()
+        for _ in range(n_pp):
+            want = pong[0] + 1
+            e0.send_am(AM_TAG_USER_BASE, 1, 0)
+            _comm_wait((e0, e1), lambda w=want: pong[0] >= w, sleep_s=0)
+        out["comm_am_roundtrip_us_socket"] = round(
+            (time.perf_counter() - t0) / n_pp * 1e6, 2)
+
+        # coalesced activations: compact positional batches with small
+        # inline payloads, decoded by the receiver's AM dispatch
+        from parsec_tpu.comm.remote_dep import pack_activation
+        inline = np.arange(64, dtype=np.float32)       # short-limit rider
+        batch = ("B", [pack_activation(
+            {"tp": 1, "tc": 0, "locals": {"m": i, "k": 3}, "outputs": [
+                {"flow_index": 0, "writeback": False, "version": 1,
+                 "inline": inline}],
+             "ranks": [0, 1], "tree": "binomial", "priority": i,
+             "seq": i, "pos": 1}) for i in range(32)])
+        got = [0]
+        e1.tag_register(AM_TAG_USER_BASE + 1, lambda eng, src, p:
+                        got.__setitem__(0, got[0] + len(p[1])))
+        nb = 20 if smoke else 100
+        t0 = time.perf_counter()
+        for _ in range(nb):
+            e0.send_am(AM_TAG_USER_BASE + 1, 1, batch)
+        _comm_wait((e0, e1), lambda: got[0] >= nb * 32)
+        out["comm_activations_per_s"] = round(
+            nb * 32 / (time.perf_counter() - t0), 1)
+
+        # -- GET throughput ladder: socket tier ----------------------------
+        for nbytes, label in sizes:
+            out[f"comm_get_socket_{label}_gbps"] = round(
+                _comm_get_gbps(e0, e1, nbytes, reps), 3)
+
+        # -- overlap: compute retired during a saturating fragmented GET --
+        big = np.random.default_rng(3).integers(
+            0, 255, size=(8 << 20) if smoke else (64 << 20), dtype=np.uint8)
+        a = np.random.default_rng(4).standard_normal((192, 192)) \
+            .astype(np.float32)
+        unit = lambda: float(np.dot(a, a).sum())        # noqa: E731
+        t0 = time.perf_counter()
+        n_cal = 20
+        for _ in range(n_cal):
+            unit()
+        unit_s = (time.perf_counter() - t0) / n_cal
+        h = e1.mem_register(big, refcount=1, owned=True)
+        done: list = []
+        units = [0]
+        t0 = time.perf_counter()
+        e0.get(h.wire(), done.append)
+        while not done:
+            unit()                      # compute retired mid-transfer
+            units[0] += 1
+            e0.progress()
+            e1.progress()
+            if time.perf_counter() - t0 > 60.0:
+                raise TimeoutError("comm overlap GET did not complete")
+        wall = time.perf_counter() - t0
+        out["comm_overlap_efficiency"] = round(
+            min(units[0] * unit_s / wall, 1.0), 3)
+        out["comm_overlap_units"] = units[0]
+        e0.fini()
+        e1.fini()
+
+        # -- the pickle baseline (legacy framing, monolithic replies) ------
+        params.set("comm_wire_binary", False)
+        params.set("comm_get_frag_bytes", 0)
+        p0, p1 = _comm_socket_pair()
+        out["comm_get_socket_pickle_gbps"] = round(
+            _comm_get_gbps(p0, p1, 4 << 20, reps), 3)
+        p0.fini()
+        p1.fini()
+        out["comm_get_speedup_vs_pickle"] = round(
+            out["comm_get_socket_4mib_gbps"]
+            / max(out["comm_get_socket_pickle_gbps"], 1e-9), 2)
+
+        # -- GET throughput ladder: inproc tier (fragment pipeline only,
+        # no sockets — the engine-protocol fixed cost) ---------------------
+        params.set("comm_wire_binary", True)
+        params.set("comm_get_frag_bytes", 1 << 20 if smoke else 4 << 20)
+        fab2 = InprocFabric(2)
+        j0, j1 = fab2.attach(0), fab2.attach(1)
+        for nbytes, label in sizes:
+            arr = np.random.default_rng(9).integers(
+                0, 255, size=nbytes, dtype=np.uint8)
+            best = None
+            for _ in range(reps):
+                h = j1.mem_register(arr, refcount=1, owned=True)
+                done = []
+                t0 = time.perf_counter()
+                j0.get(h.wire(), done.append)
+                _comm_wait((j0, j1), lambda: done, sleep_s=0)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            out[f"comm_get_inproc_{label}_gbps"] = round(
+                nbytes / best / 1e9, 3)
+    finally:
+        for k, v in saved.items():
+            params.set(k, v)
+    return out
+
+
 def run_all(smoke: bool = False, include_lowering: bool = True,
-            include_serve: bool = True) -> dict:
+            include_serve: bool = True, include_comm: bool = True) -> dict:
     """Every micro number in one dict (the bench `overhead` stage payload).
     ``include_lowering=False`` skips the only jax-touching section — the
     scheduling-path numbers then need no accelerator stack at all.
-    ``include_serve=False`` skips the serving numbers (bench.py runs them
-    in its dedicated ``serve`` stage instead of twice)."""
+    ``include_serve=False``/``include_comm=False`` skip the serving/comm
+    numbers (bench.py runs those in dedicated stages instead of twice)."""
     ntasks = 2000 if smoke else 10000
     reps = 3 if smoke else 5
     out: dict = {}
@@ -285,6 +503,8 @@ def run_all(smoke: bool = False, include_lowering: bool = True,
     if include_serve:
         out.update(bench_serve(nsub=16 if smoke else 64,
                                depth=4 if smoke else 8))
+    if include_comm:
+        out.update(bench_comm(smoke=smoke))
     if include_lowering:
         try:
             out.update(bench_lowering_cache())

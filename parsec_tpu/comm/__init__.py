@@ -17,13 +17,18 @@ Rebuild of the reference's communication stack (SURVEY §2.6, §3.4, §5.8):
 - :mod:`socket_fabric` / :mod:`multiproc` — the multi-PROCESS tier: ranks
   as separate interpreters over TCP (``run_multiproc``, the true mpiexec
   analog; set ``PARSEC_TPU_HOSTS`` for multi-host), with seq/replay/ack
-  delivery guarantees over breakable connections.
+  delivery guarantees over breakable connections and the zero-copy binary
+  wire framing (scatter-gather sends, recv_into landings — docs/COMM.md).
+- :mod:`codec` — the structured wire codec + restricted-pickle control
+  seam: payload structure as a compact meta blob, tile bytes as
+  out-of-band raw segments, never the bare pickle VM on network bytes.
 - :mod:`device_socket` — the deployable DCN tier:
   ``run_multiproc(transport="device")`` binds one JAX device per rank,
   registered payloads live device-resident, GETs land straight on the
   consumer's device, and ``jax.distributed`` bootstraps real pods.
 """
 
+from . import codec
 from .engine import (AM_TAG_ACTIVATE, AM_TAG_GET_ACK, AM_TAG_TERMDET,
                      CommEngine, InprocFabric, MemHandle)
 from .remote_dep import RemoteDepEngine, RemoteDeps
@@ -36,5 +41,5 @@ __all__ = [
     "CommEngine", "InprocFabric", "MemHandle", "RemoteDepEngine",
     "RemoteDeps", "FourCounterTermDet", "run_multirank", "run_multiproc",
     "DeviceSocketCommEngine", "AM_TAG_ACTIVATE",
-    "AM_TAG_GET_ACK", "AM_TAG_TERMDET",
+    "AM_TAG_GET_ACK", "AM_TAG_TERMDET", "codec",
 ]

@@ -1,0 +1,328 @@
+"""The wire codec: structured binary encoding with out-of-band buffers.
+
+The DCN-tier transport used to pickle whole Python object graphs per frame
+(``pickle.dumps((tag, src, payload))``), so every tile crossing ranks paid
+serialize + copy + deserialize + copy — and every inbound frame ran the
+pickle VM on network bytes.  This module replaces that with a compact
+self-describing binary encoding in the msgpack spirit:
+
+- :func:`encode` walks the payload once and returns ``(meta, segments)``:
+  ``meta`` is a small bytes blob describing the structure, ``segments`` is
+  a list of raw buffers (ndarray / big-bytes bodies) referenced **in
+  order** by the meta.  Segments are never copied — the fabric hands them
+  straight to ``socket.sendmsg`` (scatter-gather) so a tile's bytes go
+  user-buffer → kernel with zero intermediate staging.
+- :func:`decode` parses the meta and calls ``fill(view)`` for each
+  segment, in order, with a **preallocated writable destination** (the
+  final ndarray's flat byte view).  The socket receive loop passes a
+  ``recv_into`` closure, so inbound payload bytes land socket → final
+  buffer, also with zero intermediate staging.
+
+Trust boundary (docs/COMM.md): the structured tags cover everything the
+protocol layer ships (dicts/lists/tuples/scalars/str/bytes/ndarrays), and
+decoding them can only ever materialize those types — no pickle VM, no
+constructor calls.  Payload objects outside that set (user AMs carrying
+arbitrary objects) fall back to an explicit ``T_PICKLE`` blob, decoded
+through :class:`RestrictedUnpickler`, which refuses every global outside
+an allowlist (numpy/jax reconstruction + this package + a few harmless
+builtins) — ``os.system``-style gadget chains fail at find_class time.
+Data frames (rendezvous GET payloads) never carry a pickle tag at all.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any, Callable
+
+import numpy as np
+
+from ..core.params import params as _params
+
+_params.register("comm_codec_pickle_fallback", True,
+                 "allow control-frame payload nodes outside the structured "
+                 "tag set to ride as restricted-pickle blobs (decoded "
+                 "through the find_class allowlist); off makes an "
+                 "unencodable payload a send-time TypeError")
+
+# type tags ------------------------------------------------------------------
+T_NONE = 0
+T_TRUE = 1
+T_FALSE = 2
+T_INT = 3          # <q
+T_FLOAT = 4        # <d
+T_STR = 5          # <I len + utf8
+T_BYTES = 6        # <I len + raw, inline in the meta (small)
+T_LIST = 7         # <I count
+T_TUPLE = 8        # <I count
+T_DICT = 9         # <I count, then key/value pairs
+T_NDARRAY = 10     # dtype + shape header; bytes ride as the next segment
+T_JAX = 11         # same layout; decode lands a jax array (default device)
+T_PICKLE = 12      # <I len + restricted-pickle blob (control frames only)
+T_BIGBYTES = 13    # <Q len; bytes ride as the next segment
+
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+# bytes payloads at least this large ride out-of-band as segments instead
+# of being memcpy'd into the meta blob
+_BIG_BYTES = 512
+
+_I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
+
+
+def _is_jax_array(value: Any) -> bool:
+    import sys
+    jax = sys.modules.get("jax")
+    return jax is not None and isinstance(value, jax.Array)
+
+
+def wire_dtype(dtype: Any) -> str:
+    """The on-the-wire dtype name (round-trips through ``np.dtype``)."""
+    return np.dtype(dtype).str
+
+
+# ---------------------------------------------------------------------------
+# encode
+# ---------------------------------------------------------------------------
+
+def _encode_array_header(out: bytearray, tag: int, arr: np.ndarray) -> None:
+    ds = wire_dtype(arr.dtype).encode()
+    out.append(tag)
+    out.append(len(ds))
+    out += ds
+    out.append(arr.ndim)
+    for d in arr.shape:
+        out += _I64.pack(d)
+    out += _U64.pack(arr.nbytes)
+
+
+def _encode(out: bytearray, segs: list, obj: Any) -> None:
+    if obj is None:
+        out.append(T_NONE)
+    elif obj is True:
+        out.append(T_TRUE)
+    elif obj is False:
+        out.append(T_FALSE)
+    elif type(obj) is int:
+        if _I64_MIN <= obj <= _I64_MAX:
+            out.append(T_INT)
+            out += _I64.pack(obj)
+        else:
+            _encode_fallback(out, obj)
+    elif type(obj) is float:
+        out.append(T_FLOAT)
+        out += _F64.pack(obj)
+    elif type(obj) is str:
+        b = obj.encode()
+        out.append(T_STR)
+        out += _U32.pack(len(b))
+        out += b
+    elif type(obj) is bytes or type(obj) is bytearray:
+        if len(obj) >= _BIG_BYTES:
+            out.append(T_BIGBYTES)
+            out += _U64.pack(len(obj))
+            segs.append(obj)
+        else:
+            out.append(T_BYTES)
+            out += _U32.pack(len(obj))
+            out += obj
+    elif type(obj) is list:
+        out.append(T_LIST)
+        out += _U32.pack(len(obj))
+        for v in obj:
+            _encode(out, segs, v)
+    elif type(obj) is tuple:
+        out.append(T_TUPLE)
+        out += _U32.pack(len(obj))
+        for v in obj:
+            _encode(out, segs, v)
+    elif type(obj) is dict:
+        out.append(T_DICT)
+        out += _U32.pack(len(obj))
+        for k, v in obj.items():
+            _encode(out, segs, k)
+            _encode(out, segs, v)
+    elif isinstance(obj, np.ndarray):
+        if obj.dtype == object:
+            _encode_fallback(out, obj)
+            return
+        if not obj.flags.c_contiguous:
+            obj = np.ascontiguousarray(obj)
+        _encode_array_header(out, T_NDARRAY, obj)
+        if obj.nbytes:
+            segs.append(obj)
+    elif isinstance(obj, (np.bool_, np.integer, np.floating)):
+        # numpy scalars (tile versions, counters) ride as their Python kin
+        _encode(out, segs, obj.item())
+    elif _is_jax_array(obj):
+        host = np.ascontiguousarray(np.asarray(obj))
+        _encode_array_header(out, T_JAX, host)
+        if host.nbytes:
+            segs.append(host)
+    else:
+        _encode_fallback(out, obj)
+
+
+def _encode_fallback(out: bytearray, obj: Any) -> None:
+    if not _params.get("comm_codec_pickle_fallback"):
+        raise TypeError(
+            f"payload node of type {type(obj).__name__} is outside the "
+            f"structured wire tags and comm_codec_pickle_fallback is off")
+    b = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    out.append(T_PICKLE)
+    out += _U32.pack(len(b))
+    out += b
+
+
+def encode(obj: Any) -> tuple[bytearray, list]:
+    """Encode ``obj`` → ``(meta, segments)``.  Segments are zero-copy
+    references (the caller must transmit them before mutating sources —
+    registered buffers are already stable snapshots)."""
+    out = bytearray()
+    segs: list = []
+    _encode(out, segs, obj)
+    return out, segs
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
+class _Reader:
+    __slots__ = ("mv", "pos")
+
+    def __init__(self, buf: Any) -> None:
+        self.mv = memoryview(buf)
+        self.pos = 0
+
+    def take(self, n: int) -> memoryview:
+        p = self.pos
+        self.pos = p + n
+        return self.mv[p:p + n]
+
+    def u8(self) -> int:
+        p = self.pos
+        self.pos = p + 1
+        return self.mv[p]
+
+
+def _decode_array(r: _Reader, fill: Callable, to_jax: bool) -> Any:
+    dlen = r.u8()
+    dtype = np.dtype(bytes(r.take(dlen)).decode())
+    ndim = r.u8()
+    shape = tuple(_I64.unpack(r.take(8))[0] for _ in range(ndim))
+    nbytes = _U64.unpack(r.take(8))[0]
+    arr = np.empty(shape, dtype)
+    assert arr.nbytes == nbytes, (arr.nbytes, nbytes)
+    if nbytes:
+        # the zero-copy landing: fill() writes straight into the final
+        # buffer (recv_into from the socket, or a memcpy from a segment)
+        fill(memoryview(arr).cast("B"))
+    if to_jax:
+        import jax.numpy as jnp
+        return jnp.asarray(arr)
+    return arr
+
+
+def _decode(r: _Reader, fill: Callable) -> Any:
+    tag = r.u8()
+    if tag == T_NONE:
+        return None
+    if tag == T_TRUE:
+        return True
+    if tag == T_FALSE:
+        return False
+    if tag == T_INT:
+        return _I64.unpack(r.take(8))[0]
+    if tag == T_FLOAT:
+        return _F64.unpack(r.take(8))[0]
+    if tag == T_STR:
+        n = _U32.unpack(r.take(4))[0]
+        return bytes(r.take(n)).decode()
+    if tag == T_BYTES:
+        n = _U32.unpack(r.take(4))[0]
+        return bytes(r.take(n))
+    if tag == T_LIST:
+        n = _U32.unpack(r.take(4))[0]
+        return [_decode(r, fill) for _ in range(n)]
+    if tag == T_TUPLE:
+        n = _U32.unpack(r.take(4))[0]
+        return tuple(_decode(r, fill) for _ in range(n))
+    if tag == T_DICT:
+        n = _U32.unpack(r.take(4))[0]
+        return {_decode(r, fill): _decode(r, fill) for _ in range(n)}
+    if tag == T_NDARRAY:
+        return _decode_array(r, fill, to_jax=False)
+    if tag == T_JAX:
+        return _decode_array(r, fill, to_jax=True)
+    if tag == T_BIGBYTES:
+        n = _U64.unpack(r.take(8))[0]
+        buf = bytearray(n)
+        fill(memoryview(buf))
+        return bytes(buf)
+    if tag == T_PICKLE:
+        n = _U32.unpack(r.take(4))[0]
+        return restricted_loads(bytes(r.take(n)))
+    raise ValueError(f"unknown wire tag {tag}")
+
+
+def decode(meta: Any, fill: Callable[[memoryview], None]) -> Any:
+    """Decode a meta blob, pulling segment bytes through ``fill(view)``
+    (called once per segment, in encode order, with the preallocated
+    destination)."""
+    return _decode(_Reader(meta), fill)
+
+
+def decode_with_segments(meta: Any, segments: list) -> Any:
+    """Convenience decode from in-memory segments (tests, loopback)."""
+    it = iter(segments)
+
+    def fill(view: memoryview) -> None:
+        src = memoryview(next(it)).cast("B")
+        view[:] = src
+    return decode(meta, fill)
+
+
+def roundtrip(obj: Any) -> Any:
+    """encode → decode through memory (tests + the inproc codec check)."""
+    meta, segs = encode(obj)
+    return decode_with_segments(meta, segs)
+
+
+# ---------------------------------------------------------------------------
+# the restricted pickle seam (control frames only)
+# ---------------------------------------------------------------------------
+
+# (module, name) pairs outside the prefix allowlist that are still safe to
+# reconstruct — extend deliberately, never wholesale
+_SAFE_GLOBALS = {
+    ("builtins", "complex"), ("builtins", "slice"), ("builtins", "range"),
+    ("builtins", "set"), ("builtins", "frozenset"),
+    ("builtins", "bytearray"),
+    ("collections", "OrderedDict"), ("collections", "deque"),
+}
+
+# module prefixes whose globals may be reconstructed: the numeric stack
+# (ndarray/dtype revival) and this package's own wire records.  The seam
+# is defense-in-depth for same-trust-domain ranks, not a sandbox — see
+# docs/COMM.md for the boundary statement.
+_SAFE_PREFIXES = ("numpy", "jax", "jaxlib", "ml_dtypes", "parsec_tpu")
+
+
+class RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str):  # noqa: D102
+        if (module, name) in _SAFE_GLOBALS or \
+                module.split(".", 1)[0] in _SAFE_PREFIXES:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"wire pickle blob references {module}.{name}, which is "
+            f"outside the control-frame allowlist (docs/COMM.md)")
+
+
+def restricted_loads(data: bytes) -> Any:
+    """``pickle.loads`` through the control-frame allowlist."""
+    return RestrictedUnpickler(io.BytesIO(data)).load()

@@ -35,7 +35,8 @@ from typing import Any, Callable
 
 import numpy as np
 
-from .engine import InprocCommEngine, InprocFabric, MemHandle
+from ..core.params import params as _params
+from .engine import InprocCommEngine, InprocFabric, MemHandle, _LandingZone
 
 
 def is_device_array(value: Any) -> bool:
@@ -99,12 +100,49 @@ class DeviceCommEngine(InprocCommEngine):
         return super().mem_register(value, refcount, on_drained, owned=True,
                                     peers=peers)
 
-    def _finish_get(self, eng: Any, src: int, msg: dict) -> None:
+    def _land_value(self, value: Any) -> Any:
         """Land the payload on MY device (the ICI D2D pull)."""
         import jax
-        value = msg["value"]
         if is_device_array(value):
             value = jax.device_put(value, self.device)
             self.bytes_got += value.nbytes
-        msg = dict(msg, value=value)
-        super()._finish_get(eng, src, msg)
+        return value
+
+    # -- windowed multi-buffer pipelining of large D2D pulls ------------------
+    def _plan_frags(self, value: Any) -> tuple[list, dict] | None:
+        """Device arrays above the fragment threshold move as a window of
+        device sub-buffers: the owner slices device-side (no host staging),
+        each fragment is its own ``device_put`` on arrival — overlapped
+        with task execution by the receiver's progress interleaving — and
+        completion reassembles on the consumer's device."""
+        if not is_device_array(value):
+            return super()._plan_frags(value)
+        fb = _params.get("comm_get_frag_bytes")
+        if not fb or value.nbytes <= fb:
+            return None
+        itemsize = np.dtype(value.dtype).itemsize
+        per = max(int(fb) // itemsize, 1)
+        flat = value.reshape(-1)
+        pieces = []
+        for e0 in range(0, flat.shape[0], per):
+            piece = flat[e0:e0 + per]
+            pieces.append((e0 * itemsize, piece.nbytes, piece))
+        meta = {"shape": tuple(value.shape), "dtype": np.dtype(value.dtype).str,
+                "nbytes": value.nbytes, "nfrags": len(pieces),
+                "tier": "device"}
+        return pieces, meta
+
+    def _zone_write(self, zone: _LandingZone, offset: int, data: Any) -> None:
+        if zone.frags is None:
+            super()._zone_write(zone, offset, data)
+            return
+        import jax
+        zone.frags[offset] = jax.device_put(data, self.device)
+
+    def _zone_finish(self, zone: _LandingZone) -> Any:
+        if zone.frags is None:
+            return super()._zone_finish(zone)
+        import jax.numpy as jnp
+        parts = [zone.frags[off] for off in sorted(zone.frags)]
+        # bytes_got is counted by _land_value (a same-device put is free)
+        return jnp.concatenate(parts).reshape(zone.meta["shape"])

@@ -12,14 +12,16 @@ deployment-grade (one-sided put/get over the real network,
   owner's device, exactly like the in-process device tier
   (:mod:`parsec_tpu.comm.device_fabric`).
 - **GET payloads move device-to-device with one staging hop per side**:
-  serve = D2H of the registered device buffer to raw bytes, wire = the TCP
-  frame carries the flat buffer (no host object graph — dtype/shape ride
-  as metadata), land = H2D straight onto the consumer's device.  On DCN
+  serve = D2H of the registered device buffer, wire = binary frames carry
+  the flat buffer scatter-gather (no host object graph — dtype/shape ride
+  as frame metadata; ≥``comm_get_frag_bytes`` payloads stream as windowed
+  fragments that ``recv_into`` the host staging destination), land = H2D
+  straight onto the consumer's device.  On DCN
   the two staging hops are physics (NICs read host memory — the reference's
   MPI transport stages identically); on-pod ICI payloads belong to the
   compiled SPMD path (``lower_taskpool(mesh=)``), not this engine.
-- **Control AMs stay on the pickled socket path** (tiny eager records, the
-  reference's eager-protocol split).
+- **Control AMs stay on the eager CTRL-frame path** (tiny records through
+  the structured codec, the reference's eager-protocol split).
 - **Bytes are accounted per tier**: ``payload_bytes_out``/``payload_bytes_in``
   (D2H/H2D payload traffic) vs the fabric's total framed bytes — the
   device.h:151-156 traffic-counter role.
@@ -38,7 +40,7 @@ from typing import Any, Callable
 import numpy as np
 
 from .device_fabric import is_device_array
-from .engine import AM_TAG_GET_REPLY, MemHandle
+from .engine import MemHandle
 from .socket_fabric import SocketCommEngine, SocketFabric
 
 __all__ = ["DeviceSocketCommEngine", "maybe_init_distributed"]
@@ -89,30 +91,24 @@ class DeviceSocketCommEngine(SocketCommEngine):
         return super().mem_register(value, refcount, on_drained, owned=True,
                                     peers=peers)
 
-    # -- the payload wire path: flat buffer + metadata, no object graph ------
-    def _serve_get(self, eng: Any, src: int, msg: dict) -> None:
-        h = self.mem_retrieve(msg["handle"])
-        if h is None:
-            raise RuntimeError(
-                f"rank {self.rank}: GET for unknown handle {msg['handle']}")
-        arr = np.asarray(h.value)               # the D2H staging hop
-        raw = arr.tobytes()
-        self.payload_bytes_out += len(raw)
-        self.send_am(AM_TAG_GET_REPLY, msg["reply_to"],
-                     {"get_id": msg["get_id"], "raw": raw,
-                      "dtype": str(arr.dtype), "shape": arr.shape})
-        self.mem_release(msg["handle"], peer=msg["reply_to"])
+    # -- the payload wire path: flat buffers + metadata, no object graph -----
+    def _serve_value(self, h: MemHandle) -> Any:
+        """The D2H staging hop: GETs of a device-registered buffer serve
+        the host ndarray, which the binary framing then ships as raw
+        scatter-gather segments (single reply) or windowed DATA-frame
+        fragments — the pickle VM never sees payload bytes."""
+        arr = np.asarray(h.value)
+        self.payload_bytes_out += arr.nbytes
+        return arr
 
-    def _finish_get(self, eng: Any, src: int, msg: dict) -> None:
-        if "raw" in msg:
+    def _land_value(self, value: Any) -> Any:
+        """The H2D landing hop: fragments recv_into the preallocated host
+        destination; completion puts it on MY device."""
+        if isinstance(value, np.ndarray):
             import jax
-            arr = np.frombuffer(
-                msg["raw"], dtype=np.dtype(msg["dtype"])).reshape(
-                msg["shape"])
-            value = jax.device_put(arr, self.device)  # the H2D landing hop
+            value = jax.device_put(value, self.device)
             self.payload_bytes_in += value.nbytes
-            msg = {"get_id": msg["get_id"], "value": value}
-        super()._finish_get(eng, src, msg)
+        return value
 
     def tier_bytes(self) -> dict:
         """Traffic accounting per tier: payload (device path) vs total

@@ -42,6 +42,10 @@ from typing import Any, Callable
 
 import numpy as np
 
+from ..core.params import params as _params
+from ..prof import pins
+from ..prof.pins import PinsEvent
+
 # Reserved AM tags (cf. parsec_comm_engine.h:24-40).
 AM_TAG_GET_REQ = 1       # internal: rendezvous pull request
 AM_TAG_GET_REPLY = 2     # internal: rendezvous payload delivery
@@ -50,7 +54,17 @@ AM_TAG_ACTIVATE = 4      # remote-dep activation
 AM_TAG_TERMDET = 5       # termination-detection waves (fourcounter)
 AM_TAG_BARRIER = 6       # context-level sync barrier
 AM_TAG_DTD = 7           # DTD cross-rank data pushes / flushes
+AM_TAG_GET_FRAG = 8      # internal: one rendezvous payload fragment
+AM_TAG_GET_FRAG_ACK = 9  # internal: fragment credit (windowed pipelining)
 AM_TAG_USER_BASE = 16    # first tag available to applications/DSLs
+
+_params.register("comm_get_frag_bytes", 4 << 20,
+                 "rendezvous GETs above this many bytes are split into "
+                 "fragments of this size and pipelined (0 = monolithic "
+                 "replies, the pre-fragmentation wire path)")
+_params.register("comm_get_window", 4,
+                 "max in-flight unacked fragments per GET (the sender-side "
+                 "window; each landed fragment returns one credit)")
 
 
 class Capabilities:
@@ -93,6 +107,41 @@ class MemHandle:
     def wire(self) -> tuple[int, int]:
         """The on-the-wire form: (owner rank, handle id)."""
         return (self.rank, self.handle_id)
+
+
+class _FragSend:
+    """Sender-side state of one fragmented rendezvous reply: the ordered
+    piece list plus the send cursor the credit window advances."""
+
+    __slots__ = ("dst", "get_id", "handle_id", "pieces", "meta", "next")
+
+    def __init__(self, dst: int, get_id: int, handle_id: int,
+                 pieces: list, meta: dict) -> None:
+        self.dst = dst
+        self.get_id = get_id
+        self.handle_id = handle_id
+        self.pieces = pieces        # [(byte_offset, nbytes, buffer), ...]
+        self.meta = meta
+        self.next = 0
+
+
+class _LandingZone:
+    """Receiver-side state of one fragmented GET: the preallocated final
+    destination fragments ``recv_into`` (host tier) or accumulate onto
+    (device tier), plus landed-offset dedup for transport replays."""
+
+    __slots__ = ("get_id", "src", "meta", "dest", "flat", "remaining",
+                 "landed", "frags")
+
+    def __init__(self, get_id: int, src: int, meta: dict) -> None:
+        self.get_id = get_id
+        self.src = src
+        self.meta = meta
+        self.dest = None            # host tier: the final ndarray
+        self.flat = None            # its flat uint8 view (recv_into target)
+        self.remaining = int(meta["nbytes"])
+        self.landed: set[int] = set()
+        self.frags: dict[int, Any] | None = None   # device tier pieces
 
 
 class InprocFabric:
@@ -258,8 +307,24 @@ class InprocCommEngine(CommEngine):
         self._barrier_seen: dict[int, set] = {}
         self._barrier_gen = 0
         self._progress_lock = threading.Lock()
+        # fragmented-rendezvous state: receiver landing zones by get_id,
+        # sender piece cursors by (dst, get_id).  _frag_active is the
+        # lock-free busy-worker gate (a plain int read): nonzero while any
+        # zone or send window is open, so workers with plenty of tasks
+        # still interleave fragment progress (the T3-style overlap)
+        self._landing: dict[int, _LandingZone] = {}
+        self._frag_sends: dict[tuple[int, int], _FragSend] = {}
+        self._frag_lock = threading.Lock()
+        self._frag_active = 0
+        self.frags_in = 0
+        self.frag_bytes_in = 0
+        self.frags_out = 0
+        self.frag_bytes_out = 0
+        self.dup_frags = 0
         self.tag_register(AM_TAG_GET_REQ, self._serve_get)
         self.tag_register(AM_TAG_GET_REPLY, self._finish_get)
+        self.tag_register(AM_TAG_GET_FRAG, self._on_frag)
+        self.tag_register(AM_TAG_GET_FRAG_ACK, self._on_frag_ack)
         self.tag_register(AM_TAG_BARRIER, self._on_barrier)
 
     # -- AM -------------------------------------------------------------------
@@ -285,7 +350,15 @@ class InprocCommEngine(CommEngine):
         if h is None:
             raise RuntimeError(
                 f"rank {self.rank}: GET for unknown handle {msg['handle']}")
-        value = h.value
+        value = self._serve_value(h)
+        plan = self._plan_frags(value)
+        if plan is not None:
+            # large payload: windowed fragmented reply — the receiver
+            # copies fragments into its own preallocated destination, so
+            # no sender-side ownership copy is needed here
+            self._start_frag_send(msg["reply_to"], msg["get_id"],
+                                  msg["handle"], plan)
+            return
         # the DMA copy: the receiver must own its bytes (ICI read analog).
         # The registered buffer is already a private snapshot, so the LAST
         # consumer takes ownership of it instead of copying again.
@@ -304,7 +377,204 @@ class InprocCommEngine(CommEngine):
             # reconnect): the first landing completed the get — idempotent
             self.dup_get_replies += 1
             return
-        cb(msg["value"])
+        cb(self._land_value(msg["value"]))
+
+    # -- fragmentation hooks (overridden by the device tiers) -----------------
+    def _serve_value(self, h: MemHandle) -> Any:
+        """What a GET of handle ``h`` serves (device tiers stage here)."""
+        return h.value
+
+    def _land_value(self, value: Any) -> Any:
+        """Final landing transform applied to every completed GET
+        (device tiers ``device_put`` here)."""
+        return value
+
+    def _plan_frags(self, value: Any) -> tuple[list, dict] | None:
+        """Fragmentation plan for a large payload: ``(pieces, meta)`` with
+        ``pieces = [(byte_offset, nbytes, buffer), ...]``, or None for the
+        monolithic reply path."""
+        fb = _params.get("comm_get_frag_bytes")
+        if not fb or not isinstance(value, np.ndarray) \
+                or value.dtype == object or value.nbytes <= fb:
+            return None
+        v = value if value.flags.c_contiguous else np.ascontiguousarray(value)
+        flat = v.reshape(-1).view(np.uint8)
+        pieces = [(off, min(fb, v.nbytes - off), flat[off:off + fb])
+                  for off in range(0, v.nbytes, fb)]
+        meta = {"shape": tuple(v.shape), "dtype": v.dtype.str,
+                "nbytes": v.nbytes, "nfrags": len(pieces), "tier": "host"}
+        return pieces, meta
+
+    def _transport_frag(self, dst: int, get_id: int, offset: int,
+                        nbytes: int, data: Any, meta: dict | None,
+                        last: bool) -> None:
+        """Ship one fragment.  In-process: the inbox carries a VIEW of the
+        registered buffer; the receiver-side zone copy is the DMA analog.
+        The socket tier overrides this with a binary DATA frame whose raw
+        bytes ``recv_into`` the destination directly."""
+        self.fabric.deliver(dst, AM_TAG_GET_FRAG, self.rank,
+                            (get_id, offset, nbytes, meta, data))
+
+    # -- fragmentation: sender side -------------------------------------------
+    def _start_frag_send(self, dst: int, get_id: int, handle_id: int,
+                         plan: tuple[list, dict]) -> None:
+        pieces, meta = plan
+        fs = _FragSend(dst, get_id, handle_id, pieces, meta)
+        with self._frag_lock:
+            self._frag_sends[(dst, get_id)] = fs
+            self._frag_active += 1
+        for _ in range(max(int(_params.get("comm_get_window")), 1)):
+            if not self._send_next_frag(fs):
+                break
+
+    def _send_next_frag(self, fs: _FragSend) -> bool:
+        i = fs.next
+        if i >= len(fs.pieces):
+            return False
+        fs.next = i + 1
+        off, n, data = fs.pieces[i]
+        last = fs.next == len(fs.pieces)
+        self._transport_frag(fs.dst, fs.get_id, off, n, data,
+                             fs.meta if i == 0 else None, last)
+        self.frags_out += 1
+        self.frag_bytes_out += n
+        pins.fire(PinsEvent.COMM_GET_FRAG_SENT, None, n)
+        if last:
+            with self._frag_lock:
+                self._frag_sends.pop((fs.dst, fs.get_id), None)
+                self._frag_active -= 1
+            self.mem_release(fs.handle_id, peer=fs.dst)
+        return True
+
+    def _on_frag_ack(self, eng: CommEngine, src: int, payload: Any) -> None:
+        with self._frag_lock:
+            fs = self._frag_sends.get((src, payload[0]))
+        if fs is not None:
+            self._send_next_frag(fs)
+
+    # -- fragmentation: receiver side -----------------------------------------
+    def _zone_alloc(self, get_id: int, src: int, meta: dict) -> _LandingZone:
+        zone = _LandingZone(get_id, src, meta)
+        if meta.get("tier") == "device":
+            zone.frags = {}
+        else:
+            zone.dest = np.empty(meta["shape"], np.dtype(meta["dtype"]))
+            zone.flat = zone.dest.reshape(-1).view(np.uint8)
+        return zone
+
+    def landing_view(self, get_id: int, src: int, offset: int, nbytes: int,
+                     meta: dict | None) -> memoryview | None:
+        """Writable destination slice for a DATA frame's raw bytes — called
+        by the socket receive thread so payloads land socket → final buffer
+        with no staging hop.  None = duplicate/stale fragment (the caller
+        drains the bytes to scratch).
+
+        The offset is NOT marked landed here — only :meth:`landing_commit`
+        (after the bytes fully arrived) does that.  A receive that dies
+        mid-body therefore leaves no mark, and a concurrent replay on a
+        fresh connection may be handed the same slice: both writers carry
+        identical bytes, the writes are idempotent, and exactly one commit
+        wins."""
+        with self._frag_lock:
+            zone = self._landing.get(get_id)
+            if zone is None:
+                if meta is None:
+                    return None          # fragment of a completed/stale GET
+                zone = self._zone_alloc(get_id, src, meta)
+                self._landing[get_id] = zone
+                self._frag_active += 1
+            if offset in zone.landed:
+                return None              # transport replay: already landed
+        return memoryview(zone.flat[offset:offset + nbytes]).cast("B")
+
+    def landing_commit(self, get_id: int, offset: int) -> bool:
+        """Mark a fully received fragment landed; False = another delivery
+        (a replay racing on a second connection) already committed it, or
+        the zone is gone — the caller must not double-account it."""
+        with self._frag_lock:
+            zone = self._landing.get(get_id)
+            if zone is None or offset in zone.landed:
+                return False
+            zone.landed.add(offset)
+            return True
+
+    def _zone_write(self, zone: _LandingZone, offset: int, data: Any) -> None:
+        n = getattr(data, "nbytes", len(data))
+        zone.flat[offset:offset + n] = \
+            data if isinstance(data, np.ndarray) \
+            else np.frombuffer(data, np.uint8)
+
+    def _zone_finish(self, zone: _LandingZone) -> Any:
+        return zone.dest
+
+    def _on_frag(self, eng: CommEngine, src: int, payload: tuple) -> None:
+        get_id, offset, nbytes, meta, data = payload
+        with self._frag_lock:
+            zone = self._landing.get(get_id)
+            if zone is None:
+                if data is None or meta is None:
+                    # socket tier: zone was created by the recv thread and
+                    # already retired, or an in-process stale duplicate
+                    self.dup_frags += 1
+                    return
+                zone = self._zone_alloc(get_id, src, meta)
+                self._landing[get_id] = zone
+                self._frag_active += 1
+            if data is not None:
+                if offset in zone.landed:
+                    self.dup_frags += 1
+                    return
+                zone.landed.add(offset)
+        if data is not None:
+            # in-process tiers: the fragment view is copied into the final
+            # destination here, interleaved with task execution; on the
+            # socket tier the recv thread already landed the bytes
+            self._zone_write(zone, offset, data)
+        zone.remaining -= nbytes
+        self.frags_in += 1
+        self.frag_bytes_in += nbytes
+        pins.fire(PinsEvent.COMM_GET_FRAG_RECV, None, nbytes)
+        self.send_am(AM_TAG_GET_FRAG_ACK, src, (get_id,))
+        if zone.remaining > 0:
+            return
+        with self._frag_lock:
+            self._landing.pop(get_id, None)
+            self._frag_active -= 1
+        value = self._land_value(self._zone_finish(zone))
+        pins.fire(PinsEvent.COMM_GET_DONE, None, int(zone.meta["nbytes"]))
+        cb = self._pending_gets.pop(get_id, None)
+        if cb is None:
+            self.dup_get_replies += 1
+            return
+        cb(value)
+
+    def frag_state(self) -> dict:
+        """In-flight fragmentation state (flight-recorder stall dumps)."""
+        with self._frag_lock:
+            return {"landing_zones": len(self._landing),
+                    "frag_sends": len(self._frag_sends),
+                    "frags_in": self.frags_in,
+                    "frag_bytes_in": self.frag_bytes_in,
+                    "frags_out": self.frags_out,
+                    "frag_bytes_out": self.frag_bytes_out,
+                    "dup_frags": self.dup_frags}
+
+    def on_peer_failed(self, rank: int) -> int:
+        # a dead consumer's open send windows are abandoned (its credit
+        # acks will never arrive), and a dead OWNER's landing zones are
+        # dropped — leaking either would pin _frag_active nonzero and the
+        # busy-worker progress gate would fire forever.  (The pending-get
+        # callback stays unresolved, exactly like a monolithic GET_REPLY
+        # that will never arrive: context failure handling owns that.)
+        with self._frag_lock:
+            for key in [k for k in self._frag_sends if k[0] == rank]:
+                del self._frag_sends[key]
+                self._frag_active -= 1
+            for gid in [g for g, z in self._landing.items()
+                        if z.src == rank]:
+                del self._landing[gid]
+                self._frag_active -= 1
+        return super().on_peer_failed(rank)
 
     # -- progress -------------------------------------------------------------
     def pending(self) -> int:

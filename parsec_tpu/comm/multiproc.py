@@ -104,6 +104,15 @@ def _run_multiproc(nranks: int, target: str, timeout: float,
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env.pop("PJRT_LIBRARY_PATH", None)
     env.pop("PARSEC_TPU_HOSTS", None)
+    # forward the wire-path comm params the parent may have set
+    # in-process (params.set) so every rank agrees on the framing — both
+    # ends of a fabric must parse the same wire format (docs/COMM.md).
+    # An explicit PARSEC_MCA_* in the caller's environment still wins.
+    from ..core.params import params as _p
+    for name in ("comm_wire_binary", "comm_get_frag_bytes",
+                 "comm_get_window", "comm_socket_buf_bytes",
+                 "comm_codec_pickle_fallback"):
+        env.setdefault(f"PARSEC_MCA_{name}", str(_p.get(name)))
     env["PARSEC_MP_NRANKS"] = str(nranks)
     env["PARSEC_MP_TARGET"] = target
     env["PARSEC_MP_BASE_PORT"] = str(base)

@@ -102,6 +102,51 @@ def _slice_view(value: Any, view_key: tuple) -> Any:
 
 
 # ---------------------------------------------------------------------------
+# compact activation wire form: coalesced batches used to ship as nested
+# dicts (str keys repeated per message, per output, per batch entry); the
+# positional tuples below cut the meta the codec has to walk and emit to a
+# few dozen bytes per activation.  Inline ndarray payloads ride as raw
+# codec segments either way — this trims the *structure*, the codec already
+# removed the pickling of the *bytes*.
+# ---------------------------------------------------------------------------
+
+_OPT_DESC_KEYS = ("version", "inline", "wire", "shape", "dtype", "wire_view")
+
+
+def _pack_desc(d: dict) -> tuple:
+    flags = 0
+    vals = []
+    for i, k in enumerate(_OPT_DESC_KEYS):
+        if k in d:
+            flags |= 1 << i
+            vals.append(d[k])
+    return (d["flow_index"], 1 if d.get("writeback") else 0, flags, *vals)
+
+
+def _unpack_desc(t: tuple) -> dict:
+    d = {"flow_index": t[0], "writeback": bool(t[1])}
+    flags, j = t[2], 3
+    for i, k in enumerate(_OPT_DESC_KEYS):
+        if flags & (1 << i):
+            d[k] = t[j]
+            j += 1
+    return d
+
+
+def pack_activation(msg: dict) -> tuple:
+    """dict activation → positional wire tuple (tag "A")."""
+    return ("A", msg["tp"], msg["tc"], msg["locals"],
+            [_pack_desc(d) for d in msg["outputs"]], msg["ranks"],
+            msg["tree"], msg["priority"], msg["seq"], msg["pos"])
+
+
+def unpack_activation(t: tuple) -> dict:
+    return {"tp": t[1], "tc": t[2], "locals": t[3],
+            "outputs": [_unpack_desc(x) for x in t[4]], "ranks": t[5],
+            "tree": t[6], "priority": t[7], "seq": t[8], "pos": t[9]}
+
+
+# ---------------------------------------------------------------------------
 # propagation trees (cf. remote_dep.c:320-358) — positions are indices into
 # the sorted participant list, position 0 = root; children are re-derived
 # identically at every hop, so no child list rides the wire
@@ -225,13 +270,28 @@ class RemoteDepEngine:
         # spin on raw ce.progress() (sync, quiesce) must flush forwards
         # their own AM handlers stage mid-wait
         ce.flush_hook = self.flush_outgoing
-        from ..prof.counters import sde
+        from ..prof.counters import properties, sde
         sde.register_gauge(f"comm::rank{self.my_rank}::inflight",
                            self.inflight)
         sde.register_gauge(f"comm::rank{self.my_rank}::bytes_out",
                            lambda: self.payload_bytes_staged)
         sde.register_gauge(f"comm::rank{self.my_rank}::bytes_in",
                            lambda: self.payload_bytes_received)
+        # wire-level twins of the payload counters: total framed bytes the
+        # fabric moved each way, plus the fragment pipeline's own counters
+        fabric = getattr(ce, "fabric", None)
+        sde.register_gauge(f"comm::rank{self.my_rank}::wire_bytes_out",
+                           lambda: getattr(fabric, "bytes_sent", 0))
+        sde.register_gauge(f"comm::rank{self.my_rank}::wire_bytes_in",
+                           lambda: getattr(fabric, "bytes_recv", 0))
+        sde.register_gauge(f"comm::rank{self.my_rank}::frags_in",
+                           lambda: getattr(ce, "frags_in", 0))
+        sde.register_gauge(f"comm::rank{self.my_rank}::frag_bytes_in",
+                           lambda: getattr(ce, "frag_bytes_in", 0))
+        # per-peer bytes/frames/frags ledgers (socket tier) + fragment
+        # pipeline state, as one live property the snapshotter samples
+        properties.register("comm", f"rank{self.my_rank}",
+                            self._comm_property)
 
     # ------------------------------------------------------------ lifecycle
     def enable(self) -> None:
@@ -269,9 +329,24 @@ class RemoteDepEngine:
             self._comm_thread = None
         self.flush_outgoing()
         self.ce.fini()
-        from ..prof.counters import sde
-        for g in ("inflight", "bytes_out", "bytes_in"):
+        from ..prof.counters import properties, sde
+        for g in ("inflight", "bytes_out", "bytes_in", "wire_bytes_out",
+                  "wire_bytes_in", "frags_in", "frag_bytes_in"):
             sde.unregister_gauge(f"comm::rank{self.my_rank}::{g}")
+        properties.unregister("comm", f"rank{self.my_rank}")
+
+    def _comm_property(self) -> dict:
+        """The ``comm`` block of the live properties dictionary: fragment
+        pipeline state plus per-peer wire ledgers when the fabric keeps
+        them (docs/COMM.md)."""
+        out: dict = {}
+        fs = getattr(self.ce, "frag_state", None)
+        if fs is not None:
+            out.update(fs())
+        ps = getattr(getattr(self.ce, "fabric", None), "peer_stats", None)
+        if ps is not None:
+            out["peers"] = ps()
+        return out
 
     def debug_state(self) -> dict:
         """In-flight comm operations for the flight-recorder stall dump."""
@@ -289,7 +364,8 @@ class RemoteDepEngine:
                 "payload_bytes_staged": self.payload_bytes_staged,
                 "payload_bytes_received": self.payload_bytes_received,
                 "engine_pending": self.ce.pending(),
-                "comm_thread": self._comm_thread is not None}
+                "comm_thread": self._comm_thread is not None,
+                **self._comm_property()}
 
     def progress(self, es: Any = None) -> int:
         # the engine's progress drives flush_outgoing through flush_hook,
@@ -298,12 +374,15 @@ class RemoteDepEngine:
 
     # -------------------------------------------- outgoing stage (coalescing)
     def _post_activate(self, dst: int, msg: dict) -> None:
+        # well-formed activations ride the compact positional form; other
+        # dicts (tests driving the staging queue directly) pass through
+        packed = pack_activation(msg) if "tp" in msg else msg
         if not _params.get("comm_coalesce"):
-            self.ce.send_am(AM_TAG_ACTIVATE, dst, msg)
+            self.ce.send_am(AM_TAG_ACTIVATE, dst, packed)
             return
         with self._outq_lock:
             self._outq.setdefault(dst, []).append(
-                (-msg.get("priority", 0), next(self._outseq), msg))
+                (-msg.get("priority", 0), next(self._outseq), packed))
 
     def _flush_if_unthreaded(self) -> None:
         """The staging queue is the comm thread's mailbox; without one,
@@ -330,7 +409,9 @@ class RemoteDepEngine:
                 if len(msgs) == 1:
                     self.ce.send_am(AM_TAG_ACTIVATE, dst, msgs[0])
                 else:
-                    self.ce.send_am(AM_TAG_ACTIVATE, dst, {"batch": msgs})
+                    # coalesced same-peer aggregate: a flat positional
+                    # batch, no nested per-message dicts on the wire
+                    self.ce.send_am(AM_TAG_ACTIVATE, dst, ("B", msgs))
                 n += len(msgs)
         return n
 
@@ -563,9 +644,16 @@ class RemoteDepEngine:
         tp._on_dtd_message(self, src, msg)
         self.ce.send_am(AM_TAG_GET_ACK, src, {"seq": msg["seq"]})
 
-    def _on_activate(self, eng, src: int, msg: dict) -> None:
-        if "batch" in msg:
-            # a coalesced same-peer aggregate: unpack in (priority) order
+    def _on_activate(self, eng, src: int, msg: Any) -> None:
+        if type(msg) is tuple:
+            if msg[0] == "B":
+                # a coalesced aggregate: unpack in (priority) order
+                for m in msg[1]:
+                    self._on_activate(eng, src, m)
+                return
+            msg = unpack_activation(msg)
+        elif "batch" in msg:
+            # legacy dict aggregate (tests / mixed-version peers)
             for m in msg["batch"]:
                 self._on_activate(eng, src, m)
             return

@@ -63,6 +63,72 @@ class Arena:
             return {"used": self._used, "cached": len(self._free)}
 
 
+class BufferPool:
+    """Power-of-two byte-buffer freelist for the wire receive path.
+
+    The socket fabric's frame loop needs short-lived scratch buffers (frame
+    meta blobs, discard sinks for duplicate fragments) on every inbound
+    frame; allocating a fresh ``bytearray`` per frame is a copy *and* an
+    allocation on the critical path.  This pool recycles them by
+    power-of-two size class, the comm-buffer role of the reference's
+    arenas (``arena.h:49-66``) applied to raw wire bytes.
+
+    ``acquire(n)`` returns a length-``n`` writable memoryview over a pooled
+    bytearray; ``release(mv)`` returns the underlying buffer to its class.
+    Thread-safe; each class keeps at most ``max_per_class`` buffers, and
+    buffers above ``max_pooled_bytes`` are never retained (a one-off 64MiB
+    frame must not pin 64MiB forever).
+    """
+
+    def __init__(self, max_per_class: int = 8,
+                 max_pooled_bytes: int = 16 << 20) -> None:
+        self.max_per_class = max_per_class
+        self.max_pooled_bytes = max_pooled_bytes
+        self._free: dict[int, list[bytearray]] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _cls(n: int) -> int:
+        return max(1 << (int(n) - 1).bit_length(), 256)
+
+    def acquire(self, n: int) -> memoryview:
+        if n == 0:
+            return memoryview(b"")
+        size = self._cls(n)
+        with self._lock:
+            lst = self._free.get(size)
+            buf = lst.pop() if lst else None
+            if buf is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+        if buf is None:
+            buf = bytearray(size)
+        return memoryview(buf)[:n]
+
+    def release(self, mv: memoryview) -> None:
+        buf = mv.obj
+        mv.release()
+        if not isinstance(buf, bytearray) or len(buf) > self.max_pooled_bytes:
+            return
+        with self._lock:
+            lst = self._free.setdefault(len(buf), [])
+            if len(lst) < self.max_per_class:
+                lst.append(buf)
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {"classes": len(self._free),
+                    "cached": sum(len(v) for v in self._free.values()),
+                    "hits": self.hits, "misses": self.misses}
+
+
+#: process-global pool for wire frame scratch (comm/socket_fabric.py)
+wire_pool = BufferPool()
+
+
 class ArenaDatatypeRegistry:
     """Per-context id -> (arena, datatype) registry, the analog of the DTD
     arena-datatype table (``insert_function.h:99-125``)."""

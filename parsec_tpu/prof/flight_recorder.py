@@ -457,6 +457,23 @@ def runtime_report(max_workers: int = 6) -> dict:
                             + rep["dag_tasks_completed"])
     rep["h2d_bytes"] = vsums[PinsEvent.DEVICE_STAGE_IN]
     rep["comm_activations_sent"] = counts[PinsEvent.COMM_ACTIVATE_SEND]
+    if counts[PinsEvent.COMM_ACTIVATE_SEND] \
+            or counts[PinsEvent.COMM_GET_FRAG_SENT] \
+            or counts[PinsEvent.COMM_GET_FRAG_RECV] \
+            or counts[PinsEvent.COMM_GET_DONE]:
+        # wire data-path tallies (present only when comm ran, so pure
+        # single-rank runs stay byte-compatible): fragment counts and
+        # byte sums come straight from the COMM_* PINS sites
+        rep["comm"] = {
+            "activations_sent": counts[PinsEvent.COMM_ACTIVATE_SEND],
+            "acks_received": counts[PinsEvent.COMM_ACK_RECV],
+            "frags_sent": counts[PinsEvent.COMM_GET_FRAG_SENT],
+            "frag_bytes_sent": vsums[PinsEvent.COMM_GET_FRAG_SENT],
+            "frags_received": counts[PinsEvent.COMM_GET_FRAG_RECV],
+            "frag_bytes_received": vsums[PinsEvent.COMM_GET_FRAG_RECV],
+            "gets_completed": counts[PinsEvent.COMM_GET_DONE],
+            "get_bytes_landed": vsums[PinsEvent.COMM_GET_DONE],
+        }
     if counts[PinsEvent.SERVE_SUBMIT]:
         # serving-layer lifecycle tallies (serve/server.py): present only
         # when a RuntimeServer ran, so batch runs stay byte-compatible
@@ -526,6 +543,12 @@ def export_run_report(chrome_path: str | None = None) -> dict:
             if isinstance(v, (int, float)):
                 events.append({"name": f"{ns}::sched_pending", "ph": "C",
                                "ts": ts, "pid": 2, "args": {"value": v}})
+        for k, v in s.get("sde", {}).items():
+            # comm wire/fragment gauges ride as counter tracks so byte
+            # flow lines up against the ring events (docs/COMM.md)
+            if k.startswith("comm::") and isinstance(v, (int, float)):
+                events.append({"name": k, "ph": "C", "ts": ts, "pid": 2,
+                               "args": {"value": v}})
     summary = runtime_report()
     summary["profiling_streams"] = len(profiling.streams)
     summary["trace_events"] = len(events)

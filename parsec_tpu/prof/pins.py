@@ -83,6 +83,12 @@ class PinsEvent(IntEnum):
     SERVE_START = 34
     SERVE_COMPLETE = 35
     SERVE_DRAIN = 36
+    # zero-copy wire data path (comm/engine.py fragmented rendezvous) —
+    # integer payloads are byte counts, so the flight recorder's per-event
+    # vsums double as traffic counters in runtime_report's comm block
+    COMM_GET_FRAG_SENT = 37        # payload: fragment bytes served
+    COMM_GET_FRAG_RECV = 38        # payload: fragment bytes landed
+    COMM_GET_DONE = 39             # payload: total bytes of a finished GET
 
 
 Callback = Callable[[Any, Any], None]   # (execution_stream_or_none, payload)

@@ -468,6 +468,15 @@ class Context:
                     continue
                 backoff.reset()
                 task_progress(es, task, distance)
+                # fragmented GETs in flight: a BUSY worker still advances
+                # the pipeline between tasks (credit acks, fragment
+                # copies) — the T3-style compute/transfer overlap.  The
+                # gate is one lock-free int read, so task dispatch with
+                # no comm in flight pays a branch, nothing more.
+                ce = self.comm_engine
+                if ce is not None and es.th_id == 0 \
+                        and getattr(ce.ce, "_frag_active", 0):
+                    ce.progress(es)
             except BaseException as e:   # surface to waiters, don't hang
                 self.record_failure(e)
                 return
@@ -541,6 +550,11 @@ class Context:
                     continue
                 backoff.reset()
                 task_progress(es, task, distance)
+                # same busy-path overlap gate as _worker_main: fragments
+                # keep flowing while the drive loop executes tasks
+                ce = self.comm_engine
+                if ce is not None and getattr(ce.ce, "_frag_active", 0):
+                    ce.progress(es)
             except ContextWaitTimeout:
                 raise    # deadline expiry is not a context poison
             except TimeoutError as e:

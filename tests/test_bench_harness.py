@@ -78,20 +78,29 @@ def test_every_line_is_full_schema(smoke_run):
 
 
 def test_headline_lands_before_secondaries(smoke_run):
-    """The third JSON line (after overhead + dispatch + gemm) must already
-    have a nonzero headline — round 4 ordered it dead last and lost the
-    round.  The always-first overhead micro stage (ISSUE 2) rides ahead of
-    it because it is relay-independent and runs in seconds."""
+    """The fourth JSON line (after overhead + comm + dispatch + gemm) must
+    already have a nonzero headline — round 4 ordered it dead last and lost
+    the round.  The always-first CPU-safe group (overhead, ISSUE 2; comm,
+    ISSUE 4) rides ahead of it because it is relay-independent and runs in
+    seconds."""
     p, _dt, _cwd = smoke_run
     lines = _json_lines(p.stdout)
-    assert lines[2]["value"] > 0
-    assert lines[2]["extra"]["device_kind"] != "pending"
+    assert lines[3]["value"] > 0
+    assert lines[3]["extra"]["device_kind"] != "pending"
     # the overhead stage's numbers are already on the FIRST line: the perf
     # axis has evidence before any relay-dependent stage can hang
     ov = lines[0]["extra"]["overhead"]
     assert ov["dispatch_us"] > 0
     assert ov["release_tasks_per_s"] > 0
     assert ov["steal_us"] > 0
+    # the comm wire-path stage lands on the SECOND line, still before
+    # anything that can touch the relay (ISSUE 4): GET throughput, the
+    # pickled-framing baseline ratio, and nonzero overlap efficiency
+    cm = lines[1]["extra"]["comm"]
+    assert cm["comm_am_roundtrip_us_socket"] > 0
+    assert cm["comm_get_socket_4mib_gbps"] > 0
+    assert cm["comm_get_speedup_vs_pickle"] > 1.0
+    assert cm["comm_overlap_efficiency"] > 0
 
 
 def test_dynamic_stages_exercised_on_cpu(smoke_run):
@@ -113,7 +122,13 @@ def test_serve_stage_reports_throughput_and_warm_cache(smoke_run):
     assert sv["serve_p50_ms"] > 0
     assert sv["serve_p99_ms"] >= sv["serve_p50_ms"]
     assert sv["serve_lowered_cache_hits"] >= 1
-    assert sv["serve_lowered_warm_s"] < sv["serve_lowered_cold_s"]
+    # the cache-hit counter above is the real guard; the wall-clock
+    # comparison needs an absolute floor because a populated persistent
+    # XLA disk cache (any prior run on this machine) makes the "cold"
+    # submission nearly as fast as the warm one — asserting warm < cold
+    # outright is then a coin flip on scheduler noise
+    assert sv["serve_lowered_warm_s"] <= \
+        max(sv["serve_lowered_cold_s"], 0.05)
 
 
 def test_lowered_stages_report_compile_seconds(smoke_run):

@@ -54,7 +54,8 @@ class TestCoalescing:
         n = eng.flush_outgoing()
         assert n == 4
         by_dst = {dst: p for tag, dst, p in spy.sent}
-        assert [m["id"] for m in by_dst[1]["batch"]] == ["high", "mid", "low"]
+        # coalesced aggregates ride as the flat ("B", [msgs]) wire form
+        assert [m["id"] for m in by_dst[1][1]] == ["high", "mid", "low"]
         assert by_dst[2]["id"] == "other-peer"   # singletons ride unbatched
         assert all(tag == AM_TAG_ACTIVATE for tag, _, _ in spy.sent)
         assert eng.flush_outgoing() == 0
@@ -66,7 +67,7 @@ class TestCoalescing:
         for i in range(3):
             eng._post_activate(1, {"priority": 7, "id": i})
         eng.flush_outgoing()
-        assert [m["id"] for m in spy.sent[0][2]["batch"]] == [0, 1, 2]
+        assert [m["id"] for m in spy.sent[0][2][1]] == [0, 1, 2]
 
     def test_disabled_sends_immediately(self, param):
         param("comm_coalesce", False)

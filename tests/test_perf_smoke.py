@@ -31,6 +31,18 @@ PINS_DISABLED_NS_MAX = 500.0
 # ~10x headroom discipline
 SERVE_SUBMITS_PER_S_MIN = 25.0
 SERVE_P99_MS_MAX = 250.0
+# ISSUE-4 comm wire baseline (docs/COMM.md): AM roundtrip ~7µs inproc /
+# ~200-500µs localhost socket, coalesced activations ~15-25k/s, 4MiB
+# socket GET ~1.3-2 GB/s binary vs ~0.3-0.5 GB/s pickled (3-4.5x),
+# overlap efficiency 0.2-0.5 — thresholds keep the same ~10x headroom so
+# only a gross wire-path regression (a reintroduced copy, a dead window,
+# a lost speedup) fails
+COMM_AM_ROUNDTRIP_US_INPROC_MAX = 100.0
+COMM_AM_ROUNDTRIP_US_SOCKET_MAX = 5000.0
+COMM_ACTIVATIONS_PER_S_MIN = 1500.0
+COMM_GET_SOCKET_4MIB_GBPS_MIN = 0.1
+COMM_GET_SPEEDUP_VS_PICKLE_MIN = 1.5
+COMM_OVERLAP_EFFICIENCY_MIN = 0.01
 
 
 def test_compiled_dispatch_latency():
@@ -67,6 +79,24 @@ def test_serve_sustained_submission_throughput():
     assert r["serve_nsub"] == 16, r
     assert r["serve_submits_per_s"] >= SERVE_SUBMITS_PER_S_MIN, r
     assert r["serve_p99_ms"] <= SERVE_P99_MS_MAX, r
+
+
+def test_comm_wire_path_throughput_and_overlap():
+    """The zero-copy wire data path (ISSUE 4): binary framing + windowed
+    fragmented GETs must beat the pickled baseline, and compute must
+    retire while a saturating GET is in flight — tier-1's guard on the
+    comm critical path."""
+    r = microbench.bench_comm(smoke=True)
+    assert r["comm_am_roundtrip_us_inproc"] <= \
+        COMM_AM_ROUNDTRIP_US_INPROC_MAX, r
+    assert r["comm_am_roundtrip_us_socket"] <= \
+        COMM_AM_ROUNDTRIP_US_SOCKET_MAX, r
+    assert r["comm_activations_per_s"] >= COMM_ACTIVATIONS_PER_S_MIN, r
+    assert r["comm_get_socket_4mib_gbps"] >= \
+        COMM_GET_SOCKET_4MIB_GBPS_MIN, r
+    assert r["comm_get_speedup_vs_pickle"] >= \
+        COMM_GET_SPEEDUP_VS_PICKLE_MIN, r
+    assert r["comm_overlap_efficiency"] >= COMM_OVERLAP_EFFICIENCY_MIN, r
 
 
 def test_lowering_cache_warm_compile_is_near_zero():
