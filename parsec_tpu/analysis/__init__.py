@@ -1,0 +1,53 @@
+"""Static analysis: graph verification + runtime concurrency lint.
+
+The rebuild of the verification half of ``parsec_ptgpp`` (SURVEY §layer
+map: the JDF compiler *statically checks* flow-edge symmetry, access
+consistency, and unbound locals before emitting code) plus a concurrency
+lint over the runtime's own source — two prongs, one entry point:
+
+- :mod:`.graphcheck` — given a built :class:`~parsec_tpu.ptg.dsl.PTGTaskpool`
+  (or a JDF, or a populated :class:`~parsec_tpu.dtd.insert.DTDTaskpool`),
+  enumerate the concrete execution space and verify the dataflow *without
+  executing kernels*: edge symmetry in both directions, access/CTL
+  consistency, WAR/WAW hazard ordering, dependency cycles, affinity/tile
+  bounds, dead flows, and edge functions that raise (unbound locals,
+  out-of-range indices).  Findings carry task-class/flow/instance
+  provenance; :func:`check_taskpool` raises :class:`GraphCheckError` in
+  gate mode.
+- :mod:`.runtimelint` — an AST lint over ``parsec_tpu/`` itself enforcing
+  the concurrency contracts the hot paths rely on: attributes declared
+  lock-protected (module-level ``_LOCK_PROTECTED`` registries) may only be
+  mutated under their lock, lexically-nested lock acquisitions must follow
+  the module's declared ``_LOCK_ORDER``, no bare ``except:``, and no
+  ``pickle.loads`` outside the allowlisted codec seam (docs/COMM.md trust
+  boundary).
+
+Run both from the CLI (``python -m parsec_tpu.analysis``), the pytest gate
+(``tests/test_analysis.py``), or opt into enqueue-time validation with
+``--mca analysis_check 1`` (``Context.add_taskpool`` then raises a typed
+:class:`GraphCheckError` instead of letting a malformed graph hang).
+
+The per-task *dynamic* successor checker (the ``mca/pins/iterators_checker``
+rebuild) folded in from :mod:`parsec_tpu.prof.iterators_checker` is
+re-exported here so there is one analysis namespace.
+"""
+
+from .graphcheck import (Finding, GraphCheckError, GraphReport, check_dtd,
+                         check_jdf, check_ptg, check_taskpool)
+from .runtimelint import LintReport, lint_file, lint_paths, lint_self
+
+__all__ = [
+    "Finding", "GraphCheckError", "GraphReport",
+    "check_taskpool", "check_ptg", "check_dtd", "check_jdf",
+    "LintReport", "lint_file", "lint_paths", "lint_self",
+    "IteratorsCheckerError", "check_task",
+]
+
+
+def __getattr__(name):
+    # the dynamic (PINS) checker lives with the prof components; lazy so
+    # importing the static analyzers never drags the profiling stack in
+    if name in ("IteratorsCheckerError", "check_task"):
+        from ..prof import iterators_checker
+        return getattr(iterators_checker, name)
+    raise AttributeError(name)

@@ -1,0 +1,114 @@
+"""CLI: ``python -m parsec_tpu.analysis``.
+
+Runs both prongs and exits nonzero on any error-severity finding — the
+one-command CI gate (``scripts/check.sh`` wraps it together with ruff).
+
+Usage::
+
+    python -m parsec_tpu.analysis                  # self-lint + all models
+    python -m parsec_tpu.analysis --self-lint [PATH ...]
+    python -m parsec_tpu.analysis --graph cholesky --nt 6 --ranks 4
+    python -m parsec_tpu.analysis --graph path/to/graph.jdf --bind NT=4
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def _model_graphs(nt: int):
+    """Small default instances of every shipped model builder — the same
+    registry the pytest gate sweeps."""
+    from ..data_dist.matrix import (SymTwoDimBlockCyclic, TiledMatrix,
+                                    TwoDimBlockCyclic, VectorTwoDimCyclic)
+    from ..models import (cholesky, irregular, lu, pingpong, reduction,
+                          stencil, stencil2d, tiled_gemm)
+    nb = 8
+    n = nt * nb
+
+    def _vec(name):
+        return VectorTwoDimCyclic(name, lm=n, mb=nb,
+                                  init_fn=lambda m, s: np.zeros(s,
+                                                                np.float32))
+
+    yield "cholesky", cholesky.tiled_cholesky_ptg(
+        SymTwoDimBlockCyclic("A", n, n, nb, nb), devices="cpu")
+    yield "lu", lu.tiled_lu_ptg(
+        TiledMatrix.from_dense("A", lu.make_dd(n), nb, nb), devices="cpu")
+    yield "pingpong", pingpong.pingpong_ptg(_vec("V"), 2 * nt)
+    yield "reduction", reduction.bt_reduction_ptg(_vec("R"))
+    yield "stencil1d", stencil.stencil_1d_ptg(
+        _vec("S"), np.array([0.25, 0.5, 0.25]), 3)
+    yield "stencil2d", stencil2d.stencil_2d_ptg(
+        TwoDimBlockCyclic.from_dense(
+            "M", np.zeros((n, n), np.float32), nb, nb),
+        (0.5, 0.15, 0.15, 0.1, 0.1), 3)
+    A = TiledMatrix.from_dense("A", np.zeros((n, n), np.float32), nb, nb)
+    B = TiledMatrix.from_dense("B", np.zeros((n, n), np.float32), nb, nb)
+    yield "tiled_gemm", tiled_gemm.tiled_gemm_ptg(
+        A, B, TiledMatrix("C", n, n, nb, nb), devices="cpu")
+    yield "all2all", irregular.all2all_ptg(_vec("IA"), _vec("IB"), 2)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m parsec_tpu.analysis",
+        description="static dataflow verification + runtime concurrency "
+                    "lint (docs/ANALYSIS.md)")
+    ap.add_argument("--graph", metavar="MODEL|JDF",
+                    help="verify one graph: a model name (cholesky, lu, "
+                         "pingpong, reduction, stencil1d, stencil2d, "
+                         "tiled_gemm, all2all) or a .jdf path")
+    ap.add_argument("--bind", action="append", default=[],
+                    metavar="NAME=INT", help="JDF global binding")
+    ap.add_argument("--nt", type=int, default=5,
+                    help="tile-grid size for model graphs (default 5)")
+    ap.add_argument("--ranks", type=int, default=1,
+                    help="verify for this many ranks (default 1)")
+    ap.add_argument("--self-lint", action="store_true",
+                    help="run runtimelint over parsec_tpu/ (or PATHs)")
+    ap.add_argument("paths", nargs="*", help="paths for --self-lint")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print warnings")
+    args = ap.parse_args(argv)
+
+    from . import check_jdf, check_ptg, lint_paths, lint_self
+    failed = False
+    run_all = not args.graph and not args.self_lint
+
+    if args.graph or run_all:
+        if args.graph and args.graph.endswith(".jdf"):
+            binds = dict((k, int(v)) for k, v in
+                         (b.split("=", 1) for b in args.bind))
+            reports = [check_jdf(args.graph, **binds)]
+        elif args.graph:
+            graphs = dict(_model_graphs(args.nt))
+            if args.graph not in graphs:
+                ap.error(f"unknown model {args.graph!r}; "
+                         f"one of {sorted(graphs)}")
+            reports = [check_ptg(graphs[args.graph], nb_ranks=args.ranks)]
+        else:
+            reports = [check_ptg(tp, nb_ranks=args.ranks)
+                       for _name, tp in _model_graphs(args.nt)]
+        for r in reports:
+            print(r.summary())
+            shown = r.errors + (r.warnings if args.verbose else [])
+            for f in shown:
+                print("  " + repr(f))
+            failed |= not r.ok
+
+    if args.self_lint or run_all:
+        lr = lint_paths(args.paths) if args.paths else lint_self()
+        print(lr.summary())
+        for f in lr.errors + (lr.warnings if args.verbose else []):
+            print("  " + repr(f))
+        failed |= not lr.ok
+
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

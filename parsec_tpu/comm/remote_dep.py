@@ -36,7 +36,7 @@ from __future__ import annotations
 
 import itertools
 import threading
-from typing import Any, Callable
+from typing import Any
 
 import numpy as np
 

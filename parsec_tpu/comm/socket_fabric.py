@@ -83,6 +83,27 @@ _params.register("comm_socket_fault_p", 0.0,
                  "reconnect-and-replay path; 0 disables)")
 _params.register("comm_socket_fault_seed", 0,
                  "seed for the fault-injection RNG (per-rank offset added)")
+# concurrency contracts, enforced by analysis.runtimelint (docs/ANALYSIS.md):
+# receive-side channel state mutates only under _ilock (shared by every
+# per-connection receive thread), sender-side peer table and cross-peer
+# traffic ledgers only under _plock; per-peer connection entries (ent[0..3])
+# are guarded by the entry's own send lock (ent[1]) — anonymous, so outside
+# the lint's reach (kept hierarchical by construction).  No site nests the
+# two named locks; the declared order documents the intended direction.
+_LOCK_PROTECTED = {
+    "SocketFabric._inbox": "_ilock",
+    "SocketFabric._seen": "_ilock",
+    "SocketFabric._unacked_in": "_ilock",
+    "SocketFabric.peer_rx": "_ilock",
+    "SocketFabric.bytes_recv": "_ilock",
+    "SocketFabric.dup_frames": "_ilock",
+    "SocketFabric._peers": "_plock",
+    "SocketFabric._accepted": "_plock",
+    "SocketFabric.bytes_sent": "_plock",
+    "SocketFabric.peer_tx": "_plock",
+}
+_LOCK_ORDER = ("_plock", "_ilock")
+
 _params.register("comm_socket_buf_bytes", 1 << 22,
                  "SO_SNDBUF/SO_RCVBUF hint per connection (0 = OS default); "
                  "large GET fragments stream without stalling on the "
@@ -423,8 +444,10 @@ class SocketFabric:
         with self._ilock:
             dup = seq <= self._seen.get(src, 0)
         committed = False
+        dups = 0    # counted locally, published under _ilock below (the
+        # increment is a read-modify-write racing other receive threads)
         if dup:
-            self.dup_frames += 1
+            dups += 1
             if not _drain(conn, nbytes):
                 raise OSError("peer closed mid-frame (dup frag)")
         else:
@@ -446,9 +469,10 @@ class SocketFabric:
                 committed = eng is not None and \
                     eng.landing_commit(get_id, offset)
                 if not committed:
-                    self.dup_frames += 1
+                    dups += 1
         ack_now = None
         with self._ilock:
+            self.dup_frames += dups
             self._rx_account(src, _HDR.size + extra + nbytes, True)
             if not dup:
                 self._seen[src] = max(self._seen.get(src, 0), seq)

@@ -26,7 +26,6 @@ unfinished transfers keep their sender busy and block the wave.
 
 from __future__ import annotations
 
-import threading
 from typing import Any
 
 from ..core.mca import Component, component

@@ -14,6 +14,15 @@ import threading
 from collections import deque
 from typing import Any, Callable
 
+# concurrency contracts, enforced by analysis.runtimelint (docs/ANALYSIS.md):
+# HBBuffer's item list mutates only under its _lock.  StealDeque._dq is
+# deliberately NOT declared — its common path is the documented GIL-atomic
+# single-op discipline (owner pop / any-thread extend race benignly); only
+# the priority scan and steals take _steal_lock.
+_LOCK_PROTECTED = {
+    "HBBuffer._items": "_lock",
+}
+
 
 class StealDeque:
     """Sharded per-stream ready queue: the lock-free-common-path variant of
@@ -132,7 +141,8 @@ class HBBuffer:
         if overflow:
             self._parent_push(overflow, distance + 1)
 
-    def try_pop_best(self, priority: Callable[[Any], float] | None = None) -> Any | None:
+    def try_pop_best(self, priority: Callable[[Any], float] | None = None
+                     ) -> Any | None:
         with self._lock:
             if not self._items:
                 return None

@@ -35,7 +35,8 @@ class TileType:
         return int(np.prod(self.shape, dtype=np.int64)) * np.dtype(self.dtype).itemsize
 
     def compatible(self, other: "TileType") -> bool:
-        return self.shape == other.shape and np.dtype(self.dtype) == np.dtype(other.dtype)
+        return self.shape == other.shape \
+            and np.dtype(self.dtype) == np.dtype(other.dtype)
 
 
 @dataclass(frozen=True)

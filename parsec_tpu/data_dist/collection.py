@@ -36,6 +36,13 @@ class DataCollection:
     def vpid_of(self, *key) -> int:
         return 0
 
+    def has_key(self, *key) -> bool:
+        """Bounds oracle for static verification (analysis.graphcheck):
+        whether ``key`` lies inside this collection's key space.  Open
+        key spaces (lazily-registered stores) answer True for anything;
+        enumerable distributions override with their real bounds."""
+        return True
+
     def key_to_string(self, *key) -> str:
         return f"{self.name}({', '.join(map(str, key))})"
 
@@ -59,6 +66,7 @@ class DictCollection(DataCollection):
         self._init_fn = init_fn
         self._rank_of_fn = rank_of_fn
         self._keys = None if keys is None else list(keys)
+        self._keyset: frozenset | None = None   # lazy has_key index
         self._store: dict[tuple, Data] = {}
         self._lock = threading.Lock()
 
@@ -86,6 +94,18 @@ class DictCollection(DataCollection):
     def __contains__(self, key: tuple) -> bool:
         with self._lock:
             return key in self._store
+
+    def has_key(self, *key) -> bool:
+        """Declared key spaces are closed for verification; undeclared
+        dict collections stay open (keys materialize on first touch).
+        The membership index builds once — graphcheck probes this per
+        enumerated edge, so per-call set rebuilds would be quadratic."""
+        if self._keys is None:
+            return True
+        ks = self._keyset
+        if ks is None:
+            ks = self._keyset = frozenset(tuple(k) for k in self._keys)
+        return tuple(key) in ks
 
     def known_keys(self) -> list[tuple]:
         """The declared key space if one was given, else the keys

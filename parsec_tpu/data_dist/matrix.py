@@ -63,6 +63,9 @@ class TiledMatrix(DataCollection):
         e.g. the upper tiles of a lower-symmetric or off-band tiles."""
         return 0 <= m < self.mt and 0 <= n < self.nt
 
+    def has_key(self, *key) -> bool:
+        return len(key) == 2 and self.has_tile(*key)
+
     def rank_of(self, m: int, n: int) -> int:
         return 0
 
@@ -194,6 +197,9 @@ class VectorTwoDimCyclic(DataCollection):
 
     def rank_of(self, m: int) -> int:
         return m % self.P
+
+    def has_key(self, *key) -> bool:
+        return len(key) == 1 and 0 <= key[0] < self.mt
 
     def data_of(self, m: int) -> Data:
         with self._lock:

@@ -17,9 +17,6 @@ taskpool is the general path.
 
 from __future__ import annotations
 
-from typing import Any
-
-import numpy as np
 
 from ..dtd.insert import DTDTaskpool, INPUT, INOUT, VALUE
 from .matrix import TiledMatrix

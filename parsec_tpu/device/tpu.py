@@ -37,7 +37,7 @@ from ..data.data import (COHERENCY_EXCLUSIVE, COHERENCY_INVALID,
                          COHERENCY_OWNED, COHERENCY_SHARED, DataCopy)
 from ..prof import pins
 from ..prof.pins import PinsEvent
-from ..runtime.task import (HOOK_RETURN_ASYNC, HOOK_RETURN_DONE)
+from ..runtime.task import HOOK_RETURN_ASYNC
 from .device import Device, registry
 
 _params.register("device_tpu_memory_use", 90,

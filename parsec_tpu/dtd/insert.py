@@ -25,7 +25,6 @@ convention) which the engine writes back to the tile copy.
 
 from __future__ import annotations
 
-import itertools
 import threading
 from typing import Any, Callable
 
@@ -34,7 +33,6 @@ import numpy as np
 from ..core.params import params as _params
 from ..data.data import (ACCESS_READ, ACCESS_RW, ACCESS_WRITE, DataCopy,
                          data_create)
-from ..data.datatype import TileType
 from ..prof import pins
 from ..prof.pins import PinsEvent
 from ..runtime.scheduling import schedule_tasks
@@ -68,6 +66,30 @@ _params.register("dtd_threshold_size", 1024,
                  "(parsec_dtd_threshold_size)")
 
 _MAX_TASK_CLASSES = 25  # PARSEC_DTD_NB_TASK_CLASSES (insert_function_internal.h:31)
+
+# concurrency contracts, enforced by analysis.runtimelint (docs/ANALYSIS.md):
+# accessor chains mutate under the tile's _lock, per-task dep state under
+# the task's _dlock, the tile tables under _tlock, the arrival table under
+# _alock, and the in-flight window counter under _icond; the insertion
+# sequence is serialized by _insert_lock (helpers annotate `holds`).
+# The declared order is outermost-first: the inserter may take chain/task
+# locks while holding _insert_lock, never the reverse.
+_LOCK_PROTECTED = {
+    "DTDTile.last_writer": "_lock",
+    "DTDTile.last_users": "_lock",
+    "DTDTaskpool._tiles": "_tlock",
+    "DTDTaskpool._tiles_by_wire": "_tlock",
+    "DTDTaskpool._pending_flush": "_tlock",
+    "DTDTaskpool._arrivals": "_alock",
+    "DTDTaskpool._insert_seq": "_insert_lock",
+    "DTDTaskpool._inflight": "_icond",
+    "DTDTask.successors": "_dlock",
+    "DTDTask.push_records": "_dlock",
+    "DTDTask.deps_pending": "_dlock",
+    "DTDTask.completed": "_dlock",
+}
+_LOCK_ORDER = ("_insert_lock", "_tlock", "_lock", "_dlock", "_alock",
+               "_icond")
 
 
 class Scratch:
@@ -316,10 +338,27 @@ class DTDTaskpool(Taskpool):
         """Declare insertion finished: drops the armed pending action so the
         termination detector may conclude (needed when nobody calls
         :meth:`wait` on this member — e.g. inside ``compose()``)."""
+        if not self._closed and _params.get("analysis_check", False):
+            # the enqueue-time hook cannot see a DTD graph (it is empty
+            # then); end-of-insertion is the first structurally-complete
+            # moment (tasks may already have run — checks are read-only)
+            self.validate()
         self._closed = True
         if self._armed:
             self._armed = False
             self.tdm.taskpool_addto_nb_pa(-1)
+
+    def validate(self, nb_ranks: int | None = None,
+                 raise_on_error: bool = True) -> Any:
+        """Statically verify the discovered structure so far (tile/rank
+        bounds, accessor-chain consistency — analysis.graphcheck's DTD
+        prong); see :meth:`PTGTaskpool.validate
+        <parsec_tpu.ptg.dsl.PTGTaskpool.validate>`."""
+        from ..analysis import check_dtd
+        report = check_dtd(self, nb_ranks=nb_ranks)
+        if raise_on_error:
+            report.raise_if_failed()
+        return report
 
     def wait(self, timeout: float | None = None) -> None:
         """``parsec_dtd_taskpool_wait``: no more insertions; drain."""
@@ -419,7 +458,8 @@ class DTDTaskpool(Taskpool):
         return task
 
     def _insert_task_locked(self, body: Callable, args: tuple, name,
-                            priority, tpu_kernel, _rank) -> DTDTask:
+                            priority, tpu_kernel,
+                            _rank) -> DTDTask:  # lint: holds(_insert_lock)
         multirank = self.context.nb_ranks > 1
         specs: list[_ArgSpec] = []
         for a in args:

@@ -14,7 +14,6 @@ The GFLOPS harness mirrors ``testing_stencil_1D.c:142-199``:
 from __future__ import annotations
 
 import time
-from typing import Any
 
 import numpy as np
 

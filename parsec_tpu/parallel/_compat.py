@@ -1,0 +1,29 @@
+"""jax API compatibility seams for the parallelism packs.
+
+``shard_map`` graduated from ``jax.experimental`` to the top-level
+namespace in jax 0.5 and its replication-check kwarg was renamed
+``check_rep`` → ``check_vma``; ``lax.pcast`` exists only under the new
+varying-manual-axes typing.  The container floor is jax 0.4.x, so one
+guarded seam here keeps the four SPMD modules on a single source of
+truth: modern jax passes straight through, 0.4.x gets the kwarg
+translated and an identity ``pcast`` (without vma typing there is no
+carry type to stabilize).
+"""
+
+try:                                    # jax >= 0.5
+    from jax import shard_map
+except ImportError:                     # jax 0.4.x: still experimental
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _shard_map_exp(f, **kw)
+
+try:                                    # jax >= 0.6 vma typing
+    from jax.lax import pcast
+except ImportError:
+    def pcast(x, axis_name, *, to):
+        return x
+
+__all__ = ["shard_map", "pcast"]

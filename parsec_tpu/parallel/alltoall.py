@@ -18,7 +18,7 @@ from __future__ import annotations
 import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ._compat import shard_map
 
 
 def seq_to_heads_local(x, axis_name: str = "sp"):

@@ -92,7 +92,7 @@ def make_pipeline_step(mesh: Any, stage_fn: Callable, nstages: int,
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from ._compat import pcast, shard_map
     from jax.sharding import PartitionSpec as P
 
     # no wraparound pair: the last stage's activation retires into ys, and
@@ -113,8 +113,8 @@ def make_pipeline_step(mesh: Any, stage_fn: Callable, nstages: int,
         T = nmicro + nstages - 1
         # the carry varies per stage: mark it device-varying up front so the
         # scan carry type is stable (shard_map's vma typing)
-        cur0 = jax.lax.pcast(jnp.zeros_like(xs[0]), "pp", to="varying")
-        ys0 = jax.lax.pcast(jnp.zeros_like(xs), "pp", to="varying")
+        cur0 = pcast(jnp.zeros_like(xs[0]), "pp", to="varying")
+        ys0 = pcast(jnp.zeros_like(xs), "pp", to="varying")
 
         def tick(carry, t):
             cur, ys = carry

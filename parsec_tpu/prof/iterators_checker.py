@@ -7,6 +7,13 @@ target flow exists, and the successor's input deps contain a matching
 active arrow pointing back at this class.  A PTG whose out-arrows and
 in-arrows disagree (the classic hand-written-JDF bug) surfaces here as a
 hard error at the first executed task instead of a hang at the dep table.
+
+Folded into the analysis subsystem (ISSUE 5): :mod:`parsec_tpu.analysis`
+re-exports :func:`check_task` / :class:`IteratorsCheckerError`, and
+``analysis.graphcheck``'s forward edge-symmetry walk is this checker's
+*static* twin over the whole execution space — run that in CI, keep this
+PINS module for per-execution validation of dynamic/UD-keyed pools the
+static enumeration cannot cover (``--mca pins iterators_checker``).
 """
 
 from __future__ import annotations

@@ -26,7 +26,7 @@ import json
 import struct
 import threading
 import time
-from typing import Any, Iterable
+from typing import Iterable
 
 _MAGIC = b"PTPB\x01"
 

@@ -26,9 +26,8 @@ expression strings into exactly these.
 
 from __future__ import annotations
 
-import itertools
 from types import SimpleNamespace
-from typing import Any, Callable, Iterable, Sequence
+from typing import Any, Callable, Iterable
 
 from ..data.data import ACCESS_READ, ACCESS_RW, ACCESS_WRITE
 from ..runtime.task import (FLOW_CTL, HOOK_RETURN_DONE, Chore, Dep, Flow,
@@ -428,6 +427,19 @@ class PTGTaskpool(Taskpool):
         code reaches through ``__parsec_tp->super._g_<name>``; UD override
         functions receive the pool and read problem sizes through this."""
         return self._builder._g_ns()
+
+    def validate(self, nb_ranks: int | None = None,
+                 raise_on_error: bool = True) -> Any:
+        """Statically verify this pool's dataflow (analysis.graphcheck):
+        edge symmetry, access consistency, cycles, tile/rank bounds — the
+        ``parsec_ptgpp`` compile-time contract, without executing a kernel.
+        Returns the :class:`~parsec_tpu.analysis.GraphReport`; raises
+        :class:`~parsec_tpu.analysis.GraphCheckError` in gate mode."""
+        from ..analysis import check_ptg
+        report = check_ptg(self, nb_ranks=nb_ranks)
+        if raise_on_error:
+            report.raise_if_failed()
+        return report
 
     def nb_local_tasks(self) -> int:
         """Count tasks whose affinity lands on this rank (generated

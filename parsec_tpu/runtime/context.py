@@ -45,6 +45,27 @@ _params.register("props_stream", "",
                  "empty = off)")
 _params.register("props_stream_interval", 0.1,
                  "seconds between live property snapshots")
+_params.register("analysis_check", False,
+                 "statically verify each taskpool at enqueue "
+                 "(analysis.graphcheck): a malformed graph raises a typed "
+                 "GraphCheckError instead of hanging — debug/CI runs")
+
+
+# concurrency contracts, enforced by analysis.runtimelint (docs/ANALYSIS.md):
+# context bookkeeping mutates only under _lock (_cond wraps the same RLock);
+# whole-enqueue sequences serialize under _submit_lock, acquired OUTSIDE
+# _lock when both are needed.
+_LOCK_PROTECTED = {
+    "Context._active_taskpools": "_lock",
+    "Context.taskpool_list": "_lock",
+    "Context._tp_by_comm_id": "_lock",
+    "Context._next_comm_id": "_lock",
+    "Context._failure_listeners": "_lock",
+    "Context._worker_error": "_lock",
+    "Context._shutdown": "_lock",
+}
+_LOCK_ALIASES = {"_cond": "_lock"}
+_LOCK_ORDER = ("_submit_lock", "_lock")
 
 
 class ContextWaitTimeout(TimeoutError):
@@ -197,7 +218,17 @@ class Context:
         with self._submit_lock:
             self._add_taskpool_locked(tp, local_only)
 
-    def _add_taskpool_locked(self, tp: Taskpool, local_only: bool) -> None:
+    def _add_taskpool_locked(self, tp: Taskpool,
+                             local_only: bool) -> None:  # lint: holds(_submit_lock)
+        if _params.get("analysis_check"):
+            # verify BEFORE any side effect (id reservation, termdet arm):
+            # a rejected pool leaves the context untouched.  DTD pools are
+            # empty at enqueue — their check runs at close()/validate().
+            from ..ptg.dsl import PTGTaskpool
+            if isinstance(tp, PTGTaskpool):
+                from ..analysis import check_taskpool
+                check_taskpool(tp, nb_ranks=self.nb_ranks,
+                               raise_on_error=True)
         tp.context = self
         tp.local_only = local_only = tp.local_only or local_only
         pins.fire(PinsEvent.TASKPOOL_INIT, None, tp)

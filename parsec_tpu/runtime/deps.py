@@ -35,6 +35,17 @@ _params.register(
     "large triangular space — fall back to the hashed tier instead of "
     "materializing gigabytes of empty tracker slots")
 
+# concurrency contracts, enforced by analysis.runtimelint (docs/ANALYSIS.md):
+# the index store's array table and purge set mutate only under its _lock
+# (per-class slot arrays carry their OWN anonymous locks — one lock per
+# (taskpool, class), outside the lint's reach); the native tier's input
+# side-dict only under _inputs_lock.
+_LOCK_PROTECTED = {
+    "_IndexArrayStore._arrays": "_lock",
+    "_IndexArrayStore._dead": "_lock",
+    "DependencyTracking._inputs": "_inputs_lock",
+}
+
 # 64-bit key layout for the native dep table: [tpid:10][tcid:6][params:48].
 # Packing is *exact* (injective) or refused — a non-packable key falls back
 # to the Python tracker for that task, never to a lossy hash.

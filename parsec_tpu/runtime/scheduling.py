@@ -56,6 +56,16 @@ _RELEASE_DEPS_END = int(PinsEvent.RELEASE_DEPS_END)
 # distinct taskpools never cross-talk
 _wb_lock = threading.Lock()
 
+# concurrency contracts, enforced by analysis.runtimelint (docs/ANALYSIS.md):
+# PARSEC_SIM bookkeeping mutates only under the pool's _sim_lock; the
+# paranoid writeback mark only under the module-level _wb_lock.
+# (es.next_task is single-owner by thread identity, not lock-protected.)
+_LOCK_PROTECTED = {
+    "Taskpool._sim_ready": "_sim_lock",
+    "Taskpool.largest_simulation_date": "_sim_lock",
+    "DataCopy.wb_mark": "_wb_lock",
+}
+
 
 class ExecutionStream:
     """One worker's execution context (cf. ``parsec_execution_stream_t``)."""

@@ -17,7 +17,6 @@ from __future__ import annotations
 import itertools
 from typing import Any, Callable, Sequence
 
-from ..data.data import ACCESS_NONE, ACCESS_READ, ACCESS_RW, ACCESS_WRITE
 
 # Hook return protocol (cf. runtime.h:139-147).
 HOOK_RETURN_DONE = 0        # body executed to completion

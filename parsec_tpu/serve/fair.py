@@ -21,7 +21,8 @@ with any of the eleven schedulers — and select() drains the inner module
 FIRST: in a serving context the inner holds only non-submission work,
 chiefly the nested ``local_only`` pools a serve task body spawns, whose
 parent submission already holds an admission slot and a deadline
-(fair-queue-first would invert priority against the parent).  ``strict_order`` tells the runtime
+(fair-queue-first would invert priority against the parent).
+``strict_order`` tells the runtime
 hot loop to skip the keep-hot ``next_task`` bypass (``scheduling.py``)
 — a released successor must not jump every other tenant's queue.
 """

@@ -47,7 +47,7 @@ from ..prof.pins import PinsEvent
 from ..runtime.context import Context, ContextWaitTimeout
 from ..runtime.taskpool import Taskpool
 from .admission import (AdmissionController, AdmissionRejected,
-                        DeadlineExceeded, TicketCancelled)
+                        TicketCancelled)
 from .fair import FairScheduler
 
 _params.register("serve_num_cores", 2,

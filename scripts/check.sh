@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# One-command static gate: style (ruff, when installed) + concurrency lint
+# + graph verification over every shipped model (docs/ANALYSIS.md).
+#
+#   scripts/check.sh            # the full gate
+#   scripts/check.sh --fast     # lint only, skip the model-graph sweep
+#
+# Exit nonzero on the first failing stage.  The same checks run inside the
+# default pytest invocation via tests/test_analysis.py (marker: analysis),
+# so CI needs nothing beyond tier-1; this script is the local loop.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== ruff (style) =="
+if python -m ruff --version >/dev/null 2>&1; then
+    python -m ruff check parsec_tpu tests examples
+elif command -v ruff >/dev/null 2>&1; then
+    ruff check parsec_tpu tests examples
+else
+    echo "ruff not installed — skipping style stage (config lives in" \
+         "pyproject.toml [tool.ruff])"
+fi
+
+if [[ "${1:-}" == "--fast" ]]; then
+    echo "== runtimelint (concurrency + hygiene) =="
+    python -m parsec_tpu.analysis --self-lint
+else
+    echo "== runtimelint + graphcheck (every shipped model graph) =="
+    python -m parsec_tpu.analysis
+fi
+
+echo "check.sh: all stages green"

@@ -24,6 +24,12 @@ def test_ladder_is_complete():
 
 
 @pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: p.stem)
-def test_example_runs(path):
+def test_example_runs(path, param):
+    # analysis_check=1: every taskpool any example enqueues (including the
+    # multirank and serving ones) passes static verification on the way in
+    # (analysis.graphcheck — the ISSUE 5 examples gate), so the ladder run
+    # doubles as the graph-correctness sweep
+    import parsec_tpu.runtime.context  # noqa: F401 — registers the param
+    param("analysis_check", 1)
     mod = load(path)
     mod.main()   # every example self-checks and raises on failure
