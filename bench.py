@@ -75,9 +75,11 @@ def bench_gemm_gflops(n: int = 16384, nb: int = 512, reps: int = 48) -> dict:
         st, _ = jax.lax.scan(body, st, None, length=reps)
         return st
 
+    _note_partial(phase="compile", lowering_mode=low.mode)
     tc = time.perf_counter()
     _ = float(chain(stores, reps)["C"].reshape(-1)[0])  # compile + warm
     compile_s = time.perf_counter() - tc
+    _note_partial(phase="measure", compile_s=round(compile_s, 1))
     times = []
     for _i in range(3):
         t0 = time.perf_counter()
@@ -298,6 +300,22 @@ def bench_dynamic_cholesky_gflops(n: int = 8192, nb: int = 1024) -> dict:
     }
 
 
+_stage_partials: dict[str, dict] = {}
+
+
+def _note_partial(**kw) -> None:
+    """Flush partial metrics from INSIDE a running stage (keyed by the
+    ``bench-<stage>`` worker-thread name).  When the stage later dies on
+    its deadline — historically in XLA compile (BENCH_r04/r05, rc 124) —
+    the degrade record carries whatever landed here instead of losing
+    the stage entirely, and ``phase == "compile"`` at timeout turns the
+    record into a ``{"status": "compile_timeout"}`` entry."""
+    import threading
+    name = threading.current_thread().name
+    if name.startswith("bench-"):
+        _stage_partials.setdefault(name[len("bench-"):], {}).update(kw)
+
+
 def _time_lowered(low, sync_store: str, reps: int = 3):
     """Shared lowered-bench harness: device stores, jit, warm, then the
     median of ``reps`` runs each synced by a device-side SCALAR read —
@@ -312,10 +330,12 @@ def _time_lowered(low, sync_store: str, reps: int = 3):
     import jax
     st = {k: jax.device_put(v) for k, v in low.initial_stores().items()}
     jf = low.jitted()
+    _note_partial(phase="compile", lowering_mode=low.mode)
     tc = time.perf_counter()
     out = jf(st)
     _ = float(out[sync_store].reshape(-1)[0])    # compile + warm
     compile_s = time.perf_counter() - tc
+    _note_partial(phase="measure", compile_s=round(compile_s, 1))
     times = []
     for _i in range(reps):
         t0 = time.perf_counter()
@@ -489,7 +509,8 @@ def bench_overhead() -> dict:
                 or os.environ.get("JAX_PLATFORMS") or "")
     out = run_all(smoke=smoke, include_lowering=platform == "cpu",
                   include_serve=False,   # the dedicated serve stage owns it
-                  include_comm=False)    # ...and the comm stage likewise
+                  include_comm=False,    # ...and the comm stage likewise
+                  include_llm=False)     # ...and the llm stage
     out["gflops"] = 0.0   # not a throughput stage; keep the stage shape
     return out
 
@@ -561,6 +582,21 @@ def bench_serve_stage() -> dict:
     return out
 
 
+def bench_llm_stage() -> dict:
+    """The LLM inference-serving stage (microbench.bench_llm): tokens/s
+    and per-token p50/p99 of the continuous batcher over paged-KV decode
+    pools on a hot RuntimeServer, swept over concurrent streams — the
+    request-scale axis the ROADMAP's millions-of-users north star needs
+    measured.  Pure scheduler+serve path on CPU: rides the relay-safe
+    group, so the axis has numbers whatever the accelerator weather."""
+    import os
+
+    from microbench import bench_llm
+    out = bench_llm(smoke=os.environ.get("BENCH_SMOKE") == "1")
+    out["gflops"] = 0.0   # not a compute stage; keep the stage shape
+    return out
+
+
 def bench_dispatch_us(ntasks: int = 2000) -> float:
     """Per-task dispatch latency on the EP DAG (the reference's
     tests/runtime/scheduling/ep.jdf shape): enqueue-to-drain wall time over
@@ -620,6 +656,7 @@ def _staged(name, fn, *a, timeout=120.0, retries=1, **kw):
     # stage as its own taint (ADVICE round 5: the budget path diverged)
     prior = list(_abandoned)
     for attempt in range(retries + 1):
+        _stage_partials.pop(name, None)   # fresh flush per attempt
         box = {}
 
         def work():
@@ -643,11 +680,19 @@ def _staged(name, fn, *a, timeout=120.0, retries=1, **kw):
         th.join(left)
         wall = time.perf_counter() - t0
         if th.is_alive():
-            print(f"[bench] {name}: TIMEOUT after {wall:.1f}s — stage "
-                  f"thread abandoned", file=sys.stderr, flush=True)
+            # a stage dying on its deadline mid-XLA-compile is the
+            # BENCH_r04/r05 failure shape (rc 124): record it as a typed
+            # compile_timeout WITH the partial metrics the stage flushed
+            # (_note_partial) instead of losing the stage entirely
+            part = dict(_stage_partials.get(name, {}))
+            status = "compile_timeout" if part.get("phase") == "compile" \
+                else "timeout"
+            print(f"[bench] {name}: {status.upper()} after {wall:.1f}s — "
+                  f"stage thread abandoned", file=sys.stderr, flush=True)
             _abandoned.append(name)
-            return {"gflops": 0.0,
+            return {"gflops": 0.0, "status": status,
                     "error": f"stage timeout after {timeout:.0f}s",
+                    **({"partial": part} if part else {}),
                     "runtime_report": _runtime_report(),
                     **({"tainted_by": prior} if prior else {})}
         if "err" in box:
@@ -655,7 +700,9 @@ def _staged(name, fn, *a, timeout=120.0, retries=1, **kw):
             print(f"[bench] {name}: attempt {attempt + 1} failed "
                   f"({type(e).__name__}: {e})", file=sys.stderr, flush=True)
             if attempt >= retries:
+                part = dict(_stage_partials.get(name, {}))
                 return {"gflops": 0.0, "error": f"{type(e).__name__}: {e}",
+                        **({"partial": part} if part else {}),
                         "runtime_report": _runtime_report()}
             continue
         print(f"[bench] {name}: {wall:.1f}s", file=sys.stderr, flush=True)
@@ -770,6 +817,11 @@ def main() -> None:
                 "serve": {k: v for k, v in
                           res.get("serve", {}).items()
                           if k not in ("runtime_report", "gflops")},
+                # the LLM serving stage: tokens/s + per-token p50/p99
+                # with concurrent streams as the sweep axis (ISSUE 6)
+                "llm": {k: v for k, v in
+                        res.get("llm", {}).items()
+                        if k not in ("runtime_report", "gflops")},
                 "dynamic_gemm_gflops": round(dyn.get("gflops", 0.0), 1),
                 "dynamic_gemm_batched": dyn.get("batched_dispatches", 0),
                 "dynamic_gemm_breakdown": dyn.get("breakdown", {}),
@@ -879,6 +931,7 @@ def main() -> None:
     # explicit-CPU platform), so it lands even in relay-dark weather —
     # but never ahead of the headline (the round-4 ordering lesson) ---
     stage("serve", bench_serve_stage, timeout=150.0)
+    stage("llm", bench_llm_stage, timeout=150.0)
     from parsec_tpu.models.stencil import run_stencil_bench
     stage("stencil", run_stencil_bench, timeout=60.0, **cfg["stencil"])
     stage("lowered_cholesky", bench_lowered_cholesky_gflops,

@@ -1,0 +1,60 @@
+"""Ex12: LLM inference serving — continuous batching on the runtime.
+
+The serving layer's flagship tenant (``parsec_tpu/llm/``,
+``docs/LLM.md``): generation *streams* ride a hot
+:class:`~parsec_tpu.serve.RuntimeServer` through ``submit_stream``.
+Each decode iteration is a fresh PTG taskpool — a ragged per-page
+attention chain per live sequence over the paged KV cache
+(`PagedKVCollection`) — submitted under the stream's tenant, so WFQ
+arbitrates interactive decode against everything else the server runs.
+The examples ladder executes this under ``analysis_check=1``: graphcheck
+statically verifies every decode step's dataflow (edge symmetry on the
+ragged chains, WAR ordering on the tail page, page bounds via the
+``has_key`` oracle) on its way into the context.
+
+Self-check: every stream's tokens must equal the dense numpy oracle
+(:meth:`ToyLM.reference_generate`) token for token — paging, batching,
+and fairness may reorder *work*, never a sequence's own chain.
+"""
+
+from parsec_tpu.llm import ToyLM
+from parsec_tpu.serve import RuntimeServer
+
+MODEL = ToyLM()
+PROMPTS = {
+    "pro": [[3, 7, 11, 5], [40, 2, 9, 9, 30]],
+    "free": [[1, 22], [8, 30, 22, 8]],
+}
+NEW_TOKENS = 8
+
+
+def main() -> dict:
+    with RuntimeServer(nb_cores=2,
+                       tenant_weights={"pro": 4.0, "free": 1.0}) as server:
+        tickets = [(tenant, prompt,
+                    server.submit_stream(prompt,
+                                         max_new_tokens=NEW_TOKENS,
+                                         tenant=tenant))
+                   for tenant, prompts in PROMPTS.items()
+                   for prompt in prompts]
+        for tenant, prompt, tk in tickets:
+            r = tk.result(timeout=120)
+            want = MODEL.reference_generate(prompt, NEW_TOKENS)
+            assert r["tokens"] == want, (tenant, prompt, r["tokens"], want)
+        stats = server.stats()
+        llm = stats["llm"]
+        assert llm["streams_completed"] == 4, llm
+        assert llm["tokens_generated"] == 4 * NEW_TOKENS, llm
+        # every retired stream's pages went back to the free list
+        assert llm["kv"]["physical_pages"] == 0, llm["kv"]
+    return stats
+
+
+if __name__ == "__main__":
+    s = main()
+    llm = s["llm"]
+    print(f"served {llm['streams_completed']} streams / "
+          f"{llm['tokens_generated']} tokens in {llm['steps']} batched "
+          f"decode iterations; KV pages recycled: "
+          f"{llm['kv']['pages_allocated']} allocated -> "
+          f"{llm['kv']['free_pages']} free")

@@ -274,6 +274,56 @@ def bench_serve(nsub: int = 64, nthreads: int = 4, depth: int = 8,
     }
 
 
+def bench_llm(streams_sweep: tuple = (1, 4, 8), new_tokens: int = 16,
+              prompt_len: int = 8, nb_cores: int = 2,
+              smoke: bool = False) -> dict:
+    """The LLM serving axis: tokens/s and per-token p50/p99 latency of
+    the continuous batcher on a hot RuntimeServer, swept over concurrent
+    streams (the request-scale axis the ROADMAP names).  Each stream is
+    a ToyLM generation riding paged-KV decode pools — per stream per
+    token that is one ragged ATTN chain + OUT through admission, WFQ,
+    live enqueue, and the dispatch path, so tokens/s is the serving
+    stack's end-to-end fixed cost (no accelerator; ``docs/LLM.md``)."""
+    from parsec_tpu.llm import ToyLM
+    from parsec_tpu.serve import RuntimeServer
+
+    if smoke:
+        streams_sweep, new_tokens = (1, 4), 8
+    model = ToyLM()
+    out: dict = {"llm_streams_sweep": {}}
+    server = RuntimeServer(nb_cores=nb_cores)
+    try:
+        for ns in streams_sweep:
+            prompts = [[(7 * i + 3 * j) % model.vocab
+                        for j in range(prompt_len)] for i in range(ns)]
+            t0 = time.perf_counter()
+            tks = [server.submit_stream(p, max_new_tokens=new_tokens,
+                                        tenant=f"tenant{i % 2}")
+                   for i, p in enumerate(prompts)]
+            per_token: list[float] = []
+            for tk in tks:
+                per_token += tk.result(timeout=300)["per_token_s"]
+            wall = time.perf_counter() - t0
+            per_token.sort()
+            n = len(per_token)
+            out["llm_streams_sweep"][str(ns)] = {
+                "tokens_per_s": round(ns * new_tokens / wall, 1),
+                "p50_ms": round(per_token[n // 2] * 1e3, 3),
+                "p99_ms": round(
+                    per_token[min(int(n * 0.99), n - 1)] * 1e3, 3),
+            }
+        top = out["llm_streams_sweep"][str(streams_sweep[-1])]
+        out["llm_tokens_per_s"] = top["tokens_per_s"]
+        out["llm_p50_ms"] = top["p50_ms"]
+        out["llm_p99_ms"] = top["p99_ms"]
+        out["llm_new_tokens"] = new_tokens
+        out["llm_prompt_len"] = prompt_len
+        out["llm_kv"] = server.stats()["llm"]["kv"]
+    finally:
+        server.drain(timeout=60)
+    return out
+
+
 def _comm_socket_pair():
     """Two socket fabrics + engines in one process on a free localhost
     port range (the oversubscribed two-rank DCN shape)."""
@@ -487,12 +537,14 @@ def bench_comm(smoke: bool = False) -> dict:
 
 
 def run_all(smoke: bool = False, include_lowering: bool = True,
-            include_serve: bool = True, include_comm: bool = True) -> dict:
+            include_serve: bool = True, include_comm: bool = True,
+            include_llm: bool = True) -> dict:
     """Every micro number in one dict (the bench `overhead` stage payload).
     ``include_lowering=False`` skips the only jax-touching section — the
     scheduling-path numbers then need no accelerator stack at all.
-    ``include_serve=False``/``include_comm=False`` skip the serving/comm
-    numbers (bench.py runs those in dedicated stages instead of twice)."""
+    ``include_serve=False``/``include_comm=False``/``include_llm=False``
+    skip the serving/comm/LLM numbers (bench.py runs those in dedicated
+    stages instead of twice)."""
     ntasks = 2000 if smoke else 10000
     reps = 3 if smoke else 5
     out: dict = {}
@@ -503,6 +555,8 @@ def run_all(smoke: bool = False, include_lowering: bool = True,
     if include_serve:
         out.update(bench_serve(nsub=16 if smoke else 64,
                                depth=4 if smoke else 8))
+    if include_llm:
+        out.update(bench_llm(smoke=smoke))
     if include_comm:
         out.update(bench_comm(smoke=smoke))
     if include_lowering:

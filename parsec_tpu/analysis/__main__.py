@@ -52,6 +52,29 @@ def _model_graphs(nt: int):
         A, B, TiledMatrix("C", n, n, nb, nb), devices="cpu")
     yield "all2all", irregular.all2all_ptg(_vec("IA"), _vec("IB"), 2)
 
+    # the LLM serving pools (docs/LLM.md): ragged page chains + the
+    # paged-KV has_key bounds oracle, at mixed sequence lengths
+    from ..data.datatype import TileType
+    from ..data_dist.collection import DictCollection
+    from ..data_dist.paged_kv import PagedKVCollection
+    from ..llm import ToyLM, decode_step_ptg, prefill_chunks, prefill_ptg
+    model = ToyLM()
+    H, D = model.num_heads, model.head_dim
+    kv = PagedKVCollection("KV", page_size=4, num_heads=H, head_dim=D)
+    prompts = {"a": list(range(2 * nt)), "b": [1, 2]}
+    chunks = {}
+    for seq, toks in prompts.items():
+        kv.alloc_seq(seq)
+        chunks.update(prefill_chunks(model, kv, seq, toks[:-1]))
+    T = DictCollection("T", dtt=kv.default_dtt,
+                       init_fn=lambda *k: chunks[k], keys=list(chunks))
+    yield "llm_prefill", prefill_ptg(kv, T, list(prompts))
+    Q = DictCollection("Q", dtt=TileType((3, H, D), np.float32))
+    O = DictCollection("O", dtt=TileType((H, D), np.float32))
+    for seq in prompts:
+        kv.ensure_tail_slot(seq)
+    yield "llm_decode", decode_step_ptg(kv, Q, O, list(prompts))
+
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
@@ -61,7 +84,8 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--graph", metavar="MODEL|JDF",
                     help="verify one graph: a model name (cholesky, lu, "
                          "pingpong, reduction, stencil1d, stencil2d, "
-                         "tiled_gemm, all2all) or a .jdf path")
+                         "tiled_gemm, all2all, llm_prefill, llm_decode) "
+                         "or a .jdf path")
     ap.add_argument("--bind", action="append", default=[],
                     metavar="NAME=INT", help="JDF global binding")
     ap.add_argument("--nt", type=int, default=5,

@@ -1,5 +1,7 @@
-"""Data distributions (rebuild of ``parsec/data_dist/``, SURVEY §2.9)."""
+"""Data distributions (rebuild of ``parsec/data_dist/``, SURVEY §2.9;
+:class:`PagedKVCollection` is the LLM-serving member, ``docs/LLM.md``)."""
 
 from .collection import DataCollection, DictCollection
+from .paged_kv import PagedKVCollection
 
-__all__ = ["DataCollection", "DictCollection"]
+__all__ = ["DataCollection", "DictCollection", "PagedKVCollection"]

@@ -107,6 +107,14 @@ class DictCollection(DataCollection):
             ks = self._keyset = frozenset(tuple(k) for k in self._keys)
         return tuple(key) in ks
 
+    def discard(self, *key) -> bool:
+        """Drop a materialized key (serving retirement: a long-lived
+        store must not grow by every sequence it ever served).  A
+        declared key space is unaffected — the key stays legal and
+        re-materializes on next touch."""
+        with self._lock:
+            return self._store.pop(tuple(key), None) is not None
+
     def known_keys(self) -> list[tuple]:
         """The declared key space if one was given, else the keys
         materialized so far (operators enumerate what exists)."""

@@ -1,0 +1,268 @@
+"""Paged KV cache as a :class:`DataCollection` — the LLM serving datum.
+
+The inference-serving analog of the tiled matrices: a transformer KV
+cache laid out as fixed-size *pages* (vLLM's PagedAttention block table;
+"Ragged Paged Attention", arxiv 2604.15464, is the TPU-kernel treatment
+the decode task class mirrors).  Logical keys are ``(seq_id, page_idx)``;
+a per-sequence **block table** maps them to physical pages allocated
+from a free list, so sequences grow ragged without reallocation,
+fork-with-copy-on-write shares prompt pages between sequences, and the
+physical page — not the sequence — is the residency unit: each page is
+an ordinary :class:`~parsec_tpu.data.data.Data`, so the TPU device
+module's HBM LRU (``device/tpu.py``) caches, evicts, and writes back
+pages exactly like matrix tiles, and two forked sequences reading one
+shared physical page hit the SAME cache entry.
+
+Page layout: one ``(3, page_size, heads, head_dim)`` array per page —
+channel 0 the keys, channel 1 the values, channel 2 metadata with
+``page[2, 0, 0, 0]`` the page's **fill count** (valid slots).  Carrying
+the fill inside the tensor keeps the per-page attention kernel pure
+(same shapes across sequences → the PR-2 fused same-class vmapped
+dispatch can batch every live sequence's decode task into one XLA
+call) rather than threading ragged lengths through the task signature.
+
+``has_key`` answers from the block tables, so the key space is CLOSED:
+graphcheck's bounds oracle statically rejects a decode pool referencing
+a page beyond a sequence's table (``docs/LLM.md``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+import numpy as np
+
+from ..data.data import (COHERENCY_INVALID, COHERENCY_SHARED, Data,
+                         data_create)
+from ..data.datatype import TileType
+from .collection import DataCollection
+
+K_CH, V_CH, META_CH = 0, 1, 2
+
+
+class PagedKVCollection(DataCollection):
+    """Block-table-backed paged KV cache distribution.
+
+    ``rank_of(seq, page)`` defaults to ``hash(seq) % nodes`` (a whole
+    sequence's pages co-locate — decode is a per-sequence chain, so
+    page-granular distribution would put every chain hop on the wire);
+    ``rank_of_fn`` overrides.
+    """
+
+    def __init__(self, name: str = "KV", page_size: int = 16,
+                 num_heads: int = 4, head_dim: int = 8,
+                 dtype: Any = np.float32, max_pages: int = 4096,
+                 nodes: int = 1, myrank: int = 0,
+                 rank_of_fn: Callable | None = None) -> None:
+        super().__init__(name, nodes, myrank)
+        self.page_size = int(page_size)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = np.dtype(dtype)
+        self.max_pages = int(max_pages)
+        self.default_dtt = TileType(
+            (3, self.page_size, self.num_heads, self.head_dim), self.dtype)
+        self._rank_of_fn = rank_of_fn
+        self._lock = threading.RLock()
+        self._pages: dict[int, Data] = {}        # phys id -> page Data
+        self._refs: dict[int, int] = {}          # phys id -> sharers
+        self._free: list[int] = []               # recycled phys ids
+        self._next_phys = 0
+        self._tables: dict[Any, list[int]] = {}  # seq -> [phys ids]
+        self._lens: dict[Any, int] = {}          # seq -> appended tokens
+        # tallies (bench/docs surface them)
+        self.pages_allocated = 0
+        self.pages_recycled = 0
+        self.cow_copies = 0
+
+    # -- the DataCollection vtable --------------------------------------
+    def rank_of(self, *key) -> int:
+        seq, _page = key
+        if self._rank_of_fn is not None:
+            return self._rank_of_fn(seq, _page)
+        if isinstance(seq, (int, np.integer)):
+            return int(seq) % max(self.nodes, 1)
+        # deterministic across processes — Python's str hash is salted
+        # per interpreter, and ranks must AGREE on an owner
+        import zlib
+        return zlib.crc32(repr(seq).encode()) % max(self.nodes, 1)
+
+    def data_of(self, *key) -> Data:
+        seq, page = key
+        with self._lock:
+            return self._pages[self._tables[seq][page]]
+
+    def has_key(self, *key) -> bool:
+        """Bounds oracle (graphcheck): a ``(seq, page)`` key exists iff
+        the sequence is live and the page is inside its block table."""
+        if len(key) != 2:
+            return False
+        seq, page = key
+        with self._lock:
+            table = self._tables.get(seq)
+            return table is not None and isinstance(page, (int, np.integer)) \
+                and 0 <= page < len(table)
+
+    # -- page lifecycle --------------------------------------------------
+    def _new_page_locked(self) -> int:  # lint: holds(_lock)
+        if self._free:
+            phys = self._free.pop()
+            self.pages_recycled += 1
+            # recycle the Data in place: fresh zeros, every accelerator
+            # copy detached+invalidated, and the host version jumped PAST
+            # the highest version any copy ever reached — a dirty device
+            # copy of the retired tenant (on-device writes run ahead of
+            # host until writeback, device/tpu.py) must never satisfy a
+            # stage-in version check for the new one
+            d = self._pages[phys]
+            host = d.get_copy(0)
+            with d._lock:
+                maxv = max(c.version for c in d.device_copies.values())
+                stale = [i for i in d.device_copies if i != 0]
+            for idx in stale:
+                c = d.get_copy(idx)
+                if c is not None:
+                    c.coherency = COHERENCY_INVALID
+                d.detach_copy(idx)
+            host.value = np.zeros(self.default_dtt.shape, self.dtype)
+            host.version = maxv + 1
+            # a device start_write may have left the host INVALID; the
+            # zeroed host copy is now the one true version
+            host.coherency = COHERENCY_SHARED
+            d.owner_device = 0
+        else:
+            if self._next_phys >= self.max_pages:
+                raise MemoryError(
+                    f"{self.name}: out of KV pages "
+                    f"({self.max_pages} x {self.page_bytes} B)")
+            phys = self._next_phys
+            self._next_phys += 1
+            self._pages[phys] = data_create(
+                np.zeros(self.default_dtt.shape, self.dtype),
+                key=(self.name, phys), dtt=self.default_dtt, dc=self)
+        self._refs[phys] = 1
+        self.pages_allocated += 1
+        return phys
+
+    def alloc_seq(self, seq: Any) -> None:
+        """Register a sequence with an empty block table."""
+        with self._lock:
+            if seq in self._tables:
+                raise KeyError(f"sequence {seq!r} already allocated")
+            self._tables[seq] = []
+            self._lens[seq] = 0
+
+    def alloc_page(self, seq: Any) -> int:
+        """Append one fresh physical page to ``seq``'s table; returns the
+        new logical page index."""
+        with self._lock:
+            table = self._tables[seq]
+            table.append(self._new_page_locked())
+            return len(table) - 1
+
+    def ensure_tail_slot(self, seq: Any) -> tuple[int, int]:
+        """Make the next token's write slot real and writable: allocate a
+        tail page when the table is empty or the tail is full, and
+        copy-on-write a tail shared with a forked sibling.  Returns
+        ``(page_idx, slot)`` — the decode step's write position."""
+        with self._lock:
+            table = self._tables[seq]
+            n = self._lens[seq]
+            page, slot = divmod(n, self.page_size)
+            if page >= len(table):
+                table.append(self._new_page_locked())
+            elif self._refs[table[page]] > 1:
+                # shared partial tail (post-fork): writes must not leak
+                # into the sibling — private copy, refcount handed back
+                old = table[page]
+                self._refs[old] -= 1
+                phys = self._new_page_locked()
+                src = self._pages[old].get_copy(0)
+                self._pages[phys].get_copy(0).value = \
+                    np.array(src.value, copy=True)
+                table[page] = phys
+                self.cow_copies += 1
+            return page, slot
+
+    def note_appended(self, seq: Any, n: int = 1) -> None:
+        """Advance host-side bookkeeping after ``n`` tokens' K/V landed in
+        the pages (the task bodies update the in-tensor fill counts; the
+        collection's length ledger is the host-side twin the batcher and
+        ``ensure_tail_slot`` plan from)."""
+        with self._lock:
+            self._lens[seq] += n
+
+    def fork(self, parent: Any, child: Any) -> None:
+        """Copy-on-write fork: the child shares every parent page
+        (refcount++), so N continuations of one prompt hold ONE physical
+        copy of the prompt's KV — the paged-attention prefix-sharing win.
+        A shared tail is privatized lazily by :meth:`ensure_tail_slot`."""
+        with self._lock:
+            if child in self._tables:
+                raise KeyError(f"sequence {child!r} already allocated")
+            table = list(self._tables[parent])
+            for phys in table:
+                self._refs[phys] += 1
+            self._tables[child] = table
+            self._lens[child] = self._lens[parent]
+
+    def free_seq(self, seq: Any) -> int:
+        """Release a sequence; pages drop to the free list when their
+        last sharer leaves.  Returns the number of pages recycled."""
+        freed = 0
+        with self._lock:
+            for phys in self._tables.pop(seq, ()):
+                self._refs[phys] -= 1
+                if self._refs[phys] == 0:
+                    del self._refs[phys]
+                    self._free.append(phys)
+                    freed += 1
+            self._lens.pop(seq, None)
+        return freed
+
+    # -- geometry / introspection ---------------------------------------
+    @property
+    def page_bytes(self) -> int:
+        return self.default_dtt.nbytes
+
+    def seq_len(self, seq: Any) -> int:
+        with self._lock:
+            return self._lens[seq]
+
+    def npages(self, seq: Any) -> int:
+        with self._lock:
+            return len(self._tables[seq])
+
+    def block_table(self, seq: Any) -> list[int]:
+        with self._lock:
+            return list(self._tables[seq])
+
+    def live_seqs(self) -> list:
+        with self._lock:
+            return list(self._tables)
+
+    def page_fill(self, seq: Any, page: int) -> int:
+        """Valid slots of one logical page, from the length ledger (the
+        in-tensor fill count is the kernel-side twin)."""
+        with self._lock:
+            n = self._lens[seq] - page * self.page_size
+            return max(0, min(n, self.page_size))
+
+    def stats(self) -> dict:
+        with self._lock:
+            in_use = sum(len(t) for t in self._tables.values())
+            phys = len(self._refs)
+            return {
+                "seqs": len(self._tables),
+                "tokens": sum(self._lens.values()),
+                "logical_pages": in_use,
+                "physical_pages": phys,
+                "shared_pages": in_use - phys,
+                "free_pages": len(self._free),
+                "page_bytes": self.page_bytes,
+                "bytes_in_use": phys * self.page_bytes,
+                "pages_allocated": self.pages_allocated,
+                "pages_recycled": self.pages_recycled,
+                "cow_copies": self.cow_copies,
+            }

@@ -14,6 +14,7 @@ from typing import Any, Callable
 
 _lock = threading.Lock()
 _kernels: dict[tuple[str, str], Callable] = {}
+_lazy: dict[tuple[str, str], Callable] = {}
 
 
 def register_kernel(name: str, device_type: str, fn: Callable) -> Callable:
@@ -22,14 +23,41 @@ def register_kernel(name: str, device_type: str, fn: Callable) -> Callable:
     return fn
 
 
-def find_incarnation(name: str, device: Any) -> Callable | None:
+def register_lazy_kernel(name: str, device_type: str,
+                         loader: Callable[[], Callable]) -> Callable:
+    """Deferred incarnation registration — the Pallas seam.
+
+    ``loader()`` is called at most once, on the first dispatch that
+    resolves ``(name, device_type)``, and must return the body callable;
+    the result is promoted into the eager registry.  Kernels whose
+    construction is expensive or platform-conditional (a Pallas build
+    that should only trace on a real TPU, an import that would drag the
+    accelerator stack into CPU-only runs) register here instead of at
+    module import — the exact role dlopen/dlsym lazy resolution plays
+    for the reference's ``dyld=`` bodies (``device_gpu.c:201``)."""
     with _lock:
-        fn = _kernels.get((name, device.type))
-        if fn is None:
-            fn = _kernels.get((name, "*"))
-        return fn
+        _lazy[(name, device_type)] = loader
+    return loader
+
+
+def find_incarnation(name: str, device: Any) -> Callable | None:
+    for dt in (device.type, "*"):
+        with _lock:
+            fn = _kernels.get((name, dt))
+            loader = None if fn is not None else _lazy.get((name, dt))
+        if loader is not None:
+            # build OUTSIDE the lock (loaders may import jax/pallas and
+            # take seconds); a racing duplicate build is harmless — the
+            # registry keeps whichever lands, both are the same kernel
+            fn = loader()
+            with _lock:
+                _kernels[(name, dt)] = fn
+                _lazy.pop((name, dt), None)
+        if fn is not None:
+            return fn
+    return None
 
 
 def registered() -> list[tuple[str, str]]:
     with _lock:
-        return list(_kernels)
+        return sorted(set(_kernels) | set(_lazy))

@@ -1,0 +1,14 @@
+"""LLM inference serving on the runtime's own primitives: a paged KV
+cache as a :class:`~parsec_tpu.data_dist.paged_kv.PagedKVCollection`,
+ragged prefill/decode task classes (:mod:`parsec_tpu.llm.decode`), and
+continuous batching over a :class:`~parsec_tpu.serve.RuntimeServer`
+(:mod:`parsec_tpu.llm.batcher`).  See ``docs/LLM.md``."""
+
+from ..data_dist.paged_kv import PagedKVCollection
+from .batcher import ContinuousBatcher, StreamTicket
+from .decode import decode_step_ptg, prefill_chunks, prefill_ptg
+from .model import ToyLM
+
+__all__ = ["PagedKVCollection", "ToyLM", "ContinuousBatcher",
+           "StreamTicket", "decode_step_ptg", "prefill_ptg",
+           "prefill_chunks"]

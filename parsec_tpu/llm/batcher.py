@@ -1,0 +1,396 @@
+"""Continuous batching: the LLM session layer over a RuntimeServer.
+
+Orca-style iteration-level scheduling on the serving layer's own
+primitives: clients open *streams* (:meth:`ContinuousBatcher
+.submit_stream` — surfaced as ``RuntimeServer.submit_stream``), and one
+batcher thread runs the decode loop::
+
+    each iteration:
+      admit newly-arrived streams   -> prefill pools (PF tasks)
+      group live streams by tenant  -> ONE decode-step pool per tenant
+      submit all pools concurrently -> server.submit(tenant=...)
+      await tickets, read O, sample -> next token per stream
+      retire finished streams       -> kv.free_seq (pages recycle)
+
+New streams join at the next iteration boundary and finished streams
+leave without stalling the batch — continuous batching, with the
+runtime's admission control bounding the in-flight pools and the WFQ
+fair scheduler arbitrating decode pools against each other and against
+whatever dense-linear-algebra tenants share the server (the soak test
+mixes decode with a Cholesky pool, ``tests/test_llm_serve.py``).
+
+Every decode-step pool is a fresh PTG taskpool: the live re-enqueue
+path PR 3 built (``Context.add_taskpool`` under ``_submit_lock``) runs
+once per token batch, and terminated pools retire from the process
+registry (``runtime/taskpool.py``) so a million-token serving run's
+footprint stays bounded by LIVE streams, not by history.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Sequence
+
+import numpy as np
+
+from ..core.future import Future
+from ..core.params import params as _params
+from ..data.datatype import TileType
+from ..data_dist.collection import DictCollection
+from ..data_dist.paged_kv import PagedKVCollection
+from .decode import decode_step_ptg, prefill_chunks, prefill_ptg
+from .model import ToyLM
+
+_params.register("llm_page_size", 16,
+                 "tokens per KV page (PagedKVCollection block size)")
+_params.register("llm_max_batch", 32,
+                 "live decode streams a batcher serves concurrently; "
+                 "arrivals beyond it queue for the next free slot")
+_params.register("llm_max_pages", 4096,
+                 "physical KV pages the batcher's cache may hold")
+_params.register("llm_step_timeout", 60.0,
+                 "seconds the batcher waits for one decode-step pool "
+                 "before failing the streams riding it")
+
+
+class StreamTicket:
+    """One generation stream's handle.  ``tokens`` grows live — snapshot
+    with :meth:`generated`; ``result()`` blocks for the finished
+    transcript."""
+
+    def __init__(self, name: str, tenant: str) -> None:
+        self.name = name
+        self.tenant = tenant
+        self.state = "queued"
+        self.submitted_at = time.monotonic()
+        self.tokens: list[int] = []
+        self.per_token_s: list[float] = []
+        self.prefill_s: float | None = None
+        self._future: Future = Future()
+
+    def generated(self) -> list[int]:
+        """Snapshot of the tokens generated so far (the batcher appends
+        concurrently; ``list()`` of a list is atomic under the GIL)."""
+        return list(self.tokens)
+
+    def result(self, timeout: float | None = None) -> dict:
+        """Block for completion; returns ``{"tokens": [...],
+        "per_token_s": [...], "prefill_s": ...}``."""
+        kind, v = self._future.get(timeout)
+        if kind == "err":
+            raise v
+        return v
+
+    def done(self) -> bool:
+        return self._future.is_ready()
+
+    def _resolve(self) -> None:
+        self.state = "done"
+        self._future.set(("ok", {"tokens": list(self.tokens),
+                                 "per_token_s": list(self.per_token_s),
+                                 "prefill_s": self.prefill_s}))
+
+    def _fail(self, e: BaseException) -> None:
+        self.state = "failed"
+        self._future.set(("err", e))
+
+
+class _Stream:
+    __slots__ = ("seq", "tenant", "priority", "prompt", "max_new",
+                 "ticket", "cur", "devices")
+
+    def __init__(self, seq: Any, tenant: str, priority: int,
+                 prompt: Sequence[int], max_new: int,
+                 ticket: StreamTicket) -> None:
+        self.seq = seq
+        self.tenant = tenant
+        self.priority = priority
+        self.prompt = list(prompt)
+        self.max_new = max_new
+        self.ticket = ticket
+        self.cur = int(prompt[-1])
+
+
+class ContinuousBatcher:
+    """The decode loop.  Owns the paged KV cache plus the Q/O side
+    collections; rides an existing :class:`RuntimeServer` for admission,
+    fairness, and the hot context."""
+
+    def __init__(self, server: Any, model: ToyLM | None = None,
+                 kv: PagedKVCollection | None = None,
+                 max_batch: int | None = None,
+                 devices: str = "cpu") -> None:
+        self._server = server
+        self.model = model or ToyLM()
+        H, D = self.model.num_heads, self.model.head_dim
+        self.kv = kv or PagedKVCollection(
+            "llmKV", page_size=_params.get("llm_page_size"),
+            num_heads=H, head_dim=D,
+            max_pages=_params.get("llm_max_pages"))
+        assert (self.kv.num_heads, self.kv.head_dim) == (H, D), \
+            "model and KV cache disagree on head geometry"
+        self.Q = DictCollection("llmQ", dtt=TileType((3, H, D), np.float32))
+        self.O = DictCollection("llmO", dtt=TileType((H, D), np.float32))
+        self.max_batch = max_batch or _params.get("llm_max_batch")
+        self.devices = devices
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._pending: deque[_Stream] = deque()
+        self._live: list[_Stream] = []
+        self._seq_ids = itertools.count()
+        self._stop = False
+        self._abort: BaseException | None = None
+        self.steps = 0
+        self.tokens_generated = 0
+        self.streams_completed = 0
+        self._pool_seq = itertools.count()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="llm-batcher")
+        self._thread.start()
+
+    # -- client API ------------------------------------------------------
+    def submit_stream(self, prompt_tokens: Sequence[int],
+                      max_new_tokens: int = 16, tenant: str = "default",
+                      priority: int = 0) -> StreamTicket:
+        """Open one generation stream; it joins the running batch at the
+        next iteration boundary."""
+        if not prompt_tokens:
+            raise ValueError("prompt_tokens must be non-empty")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        seq = next(self._seq_ids)
+        ticket = StreamTicket(f"stream{seq}", tenant)
+        st = _Stream(seq, tenant, priority, prompt_tokens,
+                     max_new_tokens, ticket)
+        with self._lock:
+            if self._stop:
+                # typed shed, same contract as server.submit: clients
+                # catching AdmissionRejected to back off keep working
+                # through the drain window
+                from ..serve.admission import AdmissionRejected
+                raise AdmissionRejected("llm batcher is stopped")
+            self._pending.append(st)
+        self._wake.set()
+        return ticket
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "live_streams": len(self._live),
+                "queued_streams": len(self._pending),
+                "steps": self.steps,
+                "tokens_generated": self.tokens_generated,
+                "streams_completed": self.streams_completed,
+                "kv": self.kv.stats(),
+            }
+
+    def stop(self, timeout: float | None = 60.0) -> None:
+        """Graceful: no new streams, finish the live ones, join.  On
+        timeout the loop is aborted and leftover streams fail."""
+        with self._lock:
+            self._stop = True
+        self._wake.set()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            self._abort = RuntimeError("batcher stop timed out")
+            self._wake.set()
+            self._thread.join(5.0)
+
+    # -- the iteration loop ---------------------------------------------
+    def _loop(self) -> None:
+        try:
+            while True:
+                if self._abort is not None:
+                    # checked BEFORE popping arrivals: _fail_all covers
+                    # _live + _pending, so anything popped here would
+                    # slip through with an unresolved ticket
+                    self._fail_all(self._abort)
+                    return
+                with self._lock:
+                    room = self.max_batch - len(self._live)
+                    fresh = [self._pending.popleft()
+                             for _ in range(min(room, len(self._pending)))]
+                    live = list(self._live)
+                    stopping = self._stop
+                if not fresh and not live:
+                    if stopping:
+                        return
+                    self._wake.wait(0.05)
+                    self._wake.clear()
+                    continue
+                if fresh:
+                    ok = self._prefill(fresh)
+                    with self._lock:
+                        self._live.extend(ok)
+                        live = list(self._live)
+                if live:
+                    self._decode_step(live)
+        except BaseException as e:      # noqa: BLE001 — fail the streams,
+            self._fail_all(e)           # never leave clients blocked
+
+    def _retire_failed(self, streams: list[_Stream], e: BaseException,
+                       defer_pool: Any = None) -> None:
+        """Contain a failure to the streams it actually hit: one tenant's
+        shed pool (admission timeout), one stream's exhausted page budget
+        — the OTHER tenants' streams keep decoding.
+
+        ``defer_pool`` must be passed when the streams' pool may STILL BE
+        RUNNING (a step-timeout: serve tickets cannot cancel a live DAG):
+        freeing the KV pages immediately would hand them to a new stream
+        while the zombie pool's OUT tasks can still write into them —
+        the pages release only when that pool actually terminates (the
+        listener fires immediately if it already has)."""
+        with self._lock:
+            for st in streams:
+                if st in self._live:
+                    self._live.remove(st)
+        seqs = [st.seq for st in streams]
+        for st in streams:
+            st.ticket._fail(e)
+        if defer_pool is None:
+            for s in seqs:
+                self._release_stream_state(s)
+        else:
+            defer_pool.add_completion_listener(
+                lambda _tp: [self._release_stream_state(s) for s in seqs])
+
+    def _release_stream_state(self, seq: Any) -> None:
+        """Everything a retired sequence held: KV pages back to the free
+        list, its Q/O side tiles dropped — the serving footprint must be
+        bounded by LIVE streams, not by every stream ever served.  Safe
+        for a never-allocated seq (all no-ops)."""
+        self.kv.free_seq(seq)
+        self.Q.discard(seq)
+        self.O.discard(seq)
+
+    def _fail_all(self, e: BaseException) -> None:
+        with self._lock:
+            victims = self._live + list(self._pending)
+            self._live = []
+            self._pending.clear()
+        for st in victims:
+            st.ticket._fail(e)
+            self._release_stream_state(st.seq)
+
+    def _prefill(self, fresh: list[_Stream]) -> list[_Stream]:
+        """Write the new streams' prompt K/V into fresh pages, grouped
+        into one PF pool per tenant.  Returns the streams that made it —
+        an exhausted page budget fails ONE stream, a shed pool fails ONE
+        tenant's arrivals, never the whole batch."""
+        stream_chunks: dict[Any, dict[tuple, np.ndarray]] = {}
+        by_tenant: dict[str, list[_Stream]] = {}
+        for st in fresh:
+            try:
+                self.kv.alloc_seq(st.seq)
+                stream_chunks[st.seq] = prefill_chunks(
+                    self.model, self.kv, st.seq, st.prompt[:-1])
+            except BaseException as e:       # noqa: BLE001 — contain
+                self._retire_failed([st], e)
+                continue
+            st.ticket.state = "prefill"
+            by_tenant.setdefault(st.tenant, []).append(st)
+        t0 = time.perf_counter()
+        tickets: list[tuple[Any, Any, list[_Stream]]] = []
+        ok: list[_Stream] = []
+        for tenant, group in by_tenant.items():
+            seqs = [st.seq for st in group if self.kv.npages(st.seq) > 0]
+            if not seqs:
+                ok.extend(group)  # single-token prompts cache nothing
+                continue
+            # THIS group's chunks only: the T key space is what lowering
+            # and operators may walk, so it must not declare other
+            # tenants' (or failed streams') tiles
+            chunks: dict[tuple, np.ndarray] = {}
+            for st in group:
+                chunks.update(stream_chunks.get(st.seq, {}))
+            try:
+                T = DictCollection(
+                    f"llmT{next(self._pool_seq)}",
+                    dtt=self.kv.default_dtt,
+                    init_fn=lambda *k, _c=chunks: _c[k],
+                    keys=list(chunks))
+                tp = prefill_ptg(self.kv, T, seqs, devices=self.devices,
+                                 name=f"llm_prefill{next(self._pool_seq)}")
+                tickets.append((self._server.submit(
+                    tp, tenant=tenant,
+                    priority=max(st.priority for st in group)), tp, group))
+            except BaseException as e:       # noqa: BLE001 — contain
+                self._retire_failed(group, e)
+        for tk, tp, group in tickets:
+            try:
+                tk.result(timeout=_params.get("llm_step_timeout"))
+            except BaseException as e:       # noqa: BLE001 — contain
+                # the pool may still be running past its timeout: page
+                # release rides its completion, not this failure
+                self._retire_failed(group, e, defer_pool=tp)
+                continue
+            ok.extend(group)
+        dt = time.perf_counter() - t0
+        for st in ok:
+            st.ticket.prefill_s = dt
+            st.ticket.state = "decoding"
+        return ok
+
+    def _decode_step(self, live: list[_Stream]) -> None:
+        """One continuous-batching iteration over every live stream.
+        Failures are contained per stream (slot allocation) or per
+        tenant (pool shed/failure) — the rest of the batch decodes on."""
+        ready: list[_Stream] = []
+        for st in live:
+            try:
+                self.kv.ensure_tail_slot(st.seq)
+                q = self.Q.data_of(st.seq).get_copy(0)
+                q.value = self.model.q3(st.cur)
+                q.version += 1
+            except BaseException as e:       # noqa: BLE001 — contain
+                self._retire_failed([st], e)
+                continue
+            ready.append(st)
+        by_tenant: dict[str, list[_Stream]] = {}
+        for st in ready:
+            by_tenant.setdefault(st.tenant, []).append(st)
+        t0 = time.perf_counter()
+        submitted: list[tuple[Any, Any, list[_Stream]]] = []
+        for tenant, group in by_tenant.items():
+            try:
+                tp = decode_step_ptg(
+                    self.kv, self.Q, self.O, [st.seq for st in group],
+                    devices=self.devices,
+                    name=f"llm_decode{next(self._pool_seq)}")
+                submitted.append((self._server.submit(
+                    tp, tenant=tenant,
+                    priority=max(st.priority for st in group)), tp, group))
+            except BaseException as e:       # noqa: BLE001 — contain
+                self._retire_failed(group, e)
+        finished: list[_Stream] = []
+        for tk, tp, group in submitted:
+            try:
+                tk.result(timeout=_params.get("llm_step_timeout"))
+            except BaseException as e:       # noqa: BLE001 — contain
+                # the pool may still be running past its timeout: page
+                # release rides its completion, not this failure
+                self._retire_failed(group, e, defer_pool=tp)
+                continue
+            dt = time.perf_counter() - t0
+            for st in group:
+                o = np.asarray(
+                    self.O.data_of(st.seq).newest_copy().value)
+                st.cur = self.model.sample(o)
+                self.kv.note_appended(st.seq)
+                with self._lock:
+                    st.ticket.tokens.append(st.cur)
+                    st.ticket.per_token_s.append(dt)
+                    self.tokens_generated += 1
+                if len(st.ticket.tokens) >= st.max_new:
+                    finished.append(st)
+        with self._lock:
+            self.steps += 1
+            for st in finished:
+                self._live.remove(st)
+                self.streams_completed += 1
+        for st in finished:
+            self._release_stream_state(st.seq)
+            st.ticket._resolve()

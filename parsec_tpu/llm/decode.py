@@ -1,0 +1,176 @@
+"""Prefill and ragged-decode task classes over the paged KV cache.
+
+The LLM workload expressed in the runtime's own terms (ROADMAP: "ragged
+attention task class per Ragged Paged Attention", arxiv 2604.15464):
+plain PTG taskpools, so graphcheck statically verifies the per-step
+dataflow (edge symmetry, WAR ordering against the KV pages, page-bounds
+via :meth:`PagedKVCollection.has_key`) before a single token moves.
+
+**PF(s, c)** — prefill: copy prompt chunk ``c`` of sequence ``s`` into
+its KV page.  Embarrassingly parallel across chunks and sequences.
+
+**ATTN(s, p)** — one query against one KV page, online-softmax state
+threading along the sequence's ragged page list::
+
+    ATTN(s,0) -> ATTN(s,1) -> ... -> ATTN(s, NP[s]-1) -> OUT(s)
+
+Page tiles are uniform ``(3, page_size, H, D)`` (the fill count rides
+in the tensor — ``data_dist/paged_kv.py``), so every live sequence's
+ATTN tasks are the SAME class with the SAME shapes: the TPU device
+module's fused same-class dispatch (``device/tpu.py:_run_vmapped``)
+batches them into one vmapped XLA call — continuous batching meets the
+PR-2 batched dispatch at the kernel level.
+
+**OUT(s)** — finalize the attention output into the O collection and
+append the query token's k/v into the tail page.  The tail-page write
+is ordered AFTER ``ATTN(s, NP-1)``'s read of the same page by the ACC
+chain — the WAR edge graphcheck checks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from .. import ptg
+from ..data.datatype import TileType
+from ..data_dist.collection import DictCollection
+from ..data_dist.paged_kv import META_CH, PagedKVCollection
+from ..ops import ragged_attention as ra
+
+
+def prefill_ptg(kv: PagedKVCollection, T: DictCollection,
+                seqs: Sequence[Any], devices: str = "cpu",
+                name: str = "llm_prefill") -> ptg.PTGTaskpool:
+    """PF(s, c) over every allocated page of every listed sequence.
+    ``T`` holds the prompt chunk tiles, keyed ``(seq, chunk)``, in the
+    same ``(3, page_size, H, D)`` layout as the pages."""
+    NP = tuple(kv.npages(s) for s in seqs)
+    p = ptg.PTGBuilder(name, KV=kv, T=T, SEQS=tuple(seqs), NP=NP,
+                       NS=len(seqs))
+    t = p.task("PF",
+               s=ptg.span(0, lambda g, l: g.NS - 1),
+               c=lambda g, l: range(g.NP[l.s]))
+    t.affinity("KV", lambda g, l: (g.SEQS[l.s], l.c))
+    ft = t.flow("T", ptg.READ)
+    ft.input(data=("T", lambda g, l: (g.SEQS[l.s], l.c)))
+    fkv = t.flow("KV", ptg.RW)
+    fkv.input(data=("KV", lambda g, l: (g.SEQS[l.s], l.c)))
+    fkv.output(data=("KV", lambda g, l: (g.SEQS[l.s], l.c)))
+
+    def body(es: Any, task: Any, g: Any, l: Any) -> None:
+        chunk = np.asarray(task.flow_data("T").value)
+        kvw = task.flow_data("KV")
+        kvw.value = np.array(chunk, copy=True)
+        kvw.version += 1
+
+    t.body(body)
+    if devices in ("auto", "tpu"):
+        # prefill is a straight page copy; stage-in + writeback through
+        # the device tier is all the work, so no dedicated TPU kernel
+        pass
+    return p.build()
+
+
+def decode_step_ptg(kv: PagedKVCollection, Q: DictCollection,
+                    O: DictCollection, seqs: Sequence[Any],
+                    devices: str = "cpu",
+                    name: str = "llm_decode") -> ptg.PTGTaskpool:
+    """One decode iteration for every listed sequence.
+
+    Callers must have made the write slot real first
+    (:meth:`PagedKVCollection.ensure_tail_slot`), so ``NP[s] >= 1`` and
+    the tail page is private — the builder snapshots the page counts.
+    """
+    NP = tuple(kv.npages(s) for s in seqs)
+    assert all(n >= 1 for n in NP), \
+        "decode needs ensure_tail_slot() first (NP >= 1)"
+    H, D = kv.num_heads, kv.head_dim
+    p = ptg.PTGBuilder(name, KV=kv, Q=Q, O=O, SEQS=tuple(seqs), NP=NP,
+                       NS=len(seqs))
+
+    t = p.task("ATTN",
+               s=ptg.span(0, lambda g, l: g.NS - 1),
+               p=lambda g, l: range(g.NP[l.s]))
+    t.affinity("KV", lambda g, l: (g.SEQS[l.s], l.p))
+    # drain long page chains first: the step's critical path
+    t.priority(lambda g, l: g.NP[l.s] - l.p)
+    fq = t.flow("Q", ptg.READ)
+    fq.input(data=("Q", lambda g, l: (g.SEQS[l.s],)))
+    fkv = t.flow("KV", ptg.READ)
+    fkv.input(data=("KV", lambda g, l: (g.SEQS[l.s], l.p)))
+    facc = t.flow("ACC", ptg.RW, dtt=TileType((H, D + 2), np.float32))
+    facc.input(new=True, guard=lambda g, l: l.p == 0)
+    facc.input(pred=("ATTN", "ACC", lambda g, l: {"s": l.s, "p": l.p - 1}),
+               guard=lambda g, l: l.p > 0)
+    facc.output(succ=("ATTN", "ACC",
+                      lambda g, l: {"s": l.s, "p": l.p + 1}),
+                guard=lambda g, l: l.p < g.NP[l.s] - 1)
+    facc.output(succ=("OUT", "ACC", lambda g, l: {"s": l.s}),
+                guard=lambda g, l: l.p == g.NP[l.s] - 1)
+
+    def attn_body(es: Any, task: Any, g: Any, l: Any) -> None:
+        acc = task.flow_data("ACC")
+        acc.value = ra.attn_page_update_np(
+            np.asarray(task.flow_data("Q").value),
+            np.asarray(task.flow_data("KV").value),
+            np.asarray(acc.value))
+        acc.version += 1
+
+    if devices in ("auto", "tpu"):
+        t.body(device="tpu", dyld="ragged_attn_page")
+    t.body(attn_body)
+
+    o = p.task("OUT", s=ptg.span(0, lambda g, l: g.NS - 1))
+    o.affinity("KV", lambda g, l: (g.SEQS[l.s], g.NP[l.s] - 1))
+    foacc = o.flow("ACC", ptg.READ)
+    foacc.input(pred=("ATTN", "ACC",
+                      lambda g, l: {"s": l.s, "p": g.NP[l.s] - 1}))
+    foq = o.flow("Q", ptg.READ)
+    foq.input(data=("Q", lambda g, l: (g.SEQS[l.s],)))
+    fkvw = o.flow("KVW", ptg.RW)
+    fkvw.input(data=("KV", lambda g, l: (g.SEQS[l.s], g.NP[l.s] - 1)))
+    fkvw.output(data=("KV", lambda g, l: (g.SEQS[l.s], g.NP[l.s] - 1)))
+    fo = o.flow("O", ptg.WRITE, dtt=TileType((H, D), np.float32))
+    fo.input(new=True)
+    fo.output(data=("O", lambda g, l: (g.SEQS[l.s],)))
+
+    def out_body(es: Any, task: Any, g: Any, l: Any) -> None:
+        kvw = task.flow_data("KVW")
+        oc = task.flow_data("O")
+        new_page, out = ra.attn_out_np(
+            np.asarray(task.flow_data("ACC").value),
+            np.asarray(task.flow_data("Q").value),
+            np.asarray(kvw.value))
+        kvw.value = new_page
+        kvw.version += 1
+        oc.value = out
+        oc.version += 1
+
+    if devices in ("auto", "tpu"):
+        o.body(device="tpu", dyld="ragged_attn_out")
+    o.body(out_body)
+    return p.build()
+
+
+def prefill_chunks(model: Any, kv: PagedKVCollection, seq: Any,
+                   tokens: Sequence[int]) -> dict[tuple, np.ndarray]:
+    """Host-side prefill prep: allocate ``seq``'s pages for ``tokens``
+    and return the ``(seq, chunk) -> tile`` map the T collection serves.
+    Advances the length ledger — the PF tasks only move the bytes."""
+    P = kv.page_size
+    chunks: dict[tuple, np.ndarray] = {}
+    n = len(tokens)
+    for c in range((n + P - 1) // P):
+        kv.alloc_page(seq)
+        part = tokens[c * P:(c + 1) * P]
+        tile = np.zeros(kv.default_dtt.shape, kv.dtype)
+        for i, tok in enumerate(part):
+            q3 = model.q3(tok)
+            tile[0, i] = q3[1]
+            tile[1, i] = q3[2]
+        tile[META_CH, 0, 0, 0] = len(part)
+        chunks[(seq, c)] = tile
+    kv.note_appended(seq, n)
+    return chunks

@@ -1,0 +1,80 @@
+"""The toy language model the serving scenario generates with.
+
+The serving subsystem exercises runtime machinery — paged KV residency,
+ragged task graphs, continuous batching, multi-tenant fairness — not
+model quality, so the "model" is the smallest thing with real attention
+semantics: a fixed random embedding table, single-layer multi-head
+attention over the KV cache, greedy argmax sampling.  Everything is
+deterministic from the seed, so :meth:`ToyLM.reference_generate` (dense
+numpy, no paging, no runtime) is an exact oracle for what the paged
+decode pools must produce token for token.
+
+Decode semantics (shared by the pools and the oracle): the cache holds
+K/V of every token strictly BEFORE the query token; a decode step
+attends the query over the cache, samples the next token, and appends
+the query token's own K/V — so prefill caches ``prompt[:-1]`` and the
+first decode query is ``prompt[-1]``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..ops.ragged_attention import ragged_attention_reference
+
+
+class ToyLM:
+    """One attention layer over a fixed embedding table.
+
+    For token ``t`` with embedding ``e``: ``q = e``, ``k = roll(e, 1)``
+    (shifted so scores are not a pure self-similarity peak), ``v =
+    e[..., ::-1]``; logits are ``o · E^T`` over the flattened heads.
+    """
+
+    def __init__(self, vocab: int = 64, num_heads: int = 4,
+                 head_dim: int = 8, seed: int = 1234) -> None:
+        self.vocab = int(vocab)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        rng = np.random.default_rng(seed)
+        self.emb = rng.standard_normal(
+            (self.vocab, self.num_heads, self.head_dim)).astype(np.float32)
+
+    def q3(self, token: int) -> np.ndarray:
+        """The ``(3, H, D)`` q/k/v stack of one token — the Q-collection
+        tile the decode pools read (``llm/decode.py``)."""
+        e = self.emb[int(token) % self.vocab]
+        return np.stack([e, np.roll(e, 1, axis=-1), e[..., ::-1]])
+
+    def sample(self, o: np.ndarray) -> int:
+        """Greedy: argmax of ``o · E^T`` (deterministic — the serving
+        tests compare token-for-token against the oracle)."""
+        logits = self.emb.reshape(self.vocab, -1) @ np.asarray(
+            o, np.float32).reshape(-1)
+        return int(np.argmax(logits))
+
+    def reference_generate(self, prompt: Sequence[int],
+                           max_new_tokens: int) -> list[int]:
+        """Dense, unpaged decode loop — the oracle the paged pools and
+        the continuous batcher must match exactly."""
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        ks: list[np.ndarray] = []
+        vs: list[np.ndarray] = []
+        for t in prompt[:-1]:
+            q3 = self.q3(t)
+            ks.append(q3[1])
+            vs.append(q3[2])
+        cur = int(prompt[-1])
+        out: list[int] = []
+        for _ in range(max_new_tokens):
+            q3 = self.q3(cur)
+            o = ragged_attention_reference(q3[0], np.array(ks),
+                                           np.array(vs))
+            ks.append(q3[1])
+            vs.append(q3[2])
+            cur = self.sample(o)
+            out.append(cur)
+        return out

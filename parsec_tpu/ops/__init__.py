@@ -1,5 +1,5 @@
 """Kernel library: XLA/Pallas incarnations for task bodies."""
 
-from . import gemm, stencil
+from . import gemm, ragged_attention, stencil
 
-__all__ = ["gemm", "stencil"]
+__all__ = ["gemm", "ragged_attention", "stencil"]

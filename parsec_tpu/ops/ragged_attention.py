@@ -1,0 +1,279 @@
+"""Ragged paged-attention kernels: the LLM decode incarnations.
+
+The per-page online-softmax update at the heart of the decode task class
+(``parsec_tpu/llm/decode.py``), in three incarnations sharing one math:
+
+- :func:`attn_page_update_np` / :func:`attn_out_np` — plain numpy, the
+  CPU task bodies (fast for the host-dispatched dynamic path: no tracing
+  per task);
+- jnp twins, registered as **traceables** under ``"ragged_attn_page"`` /
+  ``"ragged_attn_out"`` so the PR-2 fused same-class dispatch can vmap
+  every live sequence's decode task into ONE XLA call — page shapes are
+  uniform by construction (the fill count rides inside the page tensor,
+  :mod:`parsec_tpu.data_dist.paged_kv`), which is exactly what makes the
+  ragged batch vmappable;
+- a **Pallas** build seam (:func:`build_pallas_page_update`), resolved
+  through the lazy kernel registry (``device/kernels.py``) when the
+  ``llm_use_pallas`` MCA param is set — the "Ragged Paged Attention"
+  (arxiv 2604.15464) kernel slot; off-TPU it runs in interpret mode so
+  the seam stays CI-testable.
+
+The accumulator tile is ``(H, D+2)``: columns ``[:D]`` the unnormalized
+weighted value sum, ``[D]`` the running max, ``[D+1]`` the running
+softmax denominator (flash-attention state).  ``l == 0`` encodes the
+empty accumulator (zeros-init NEW tiles work unchanged).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ..core.params import params as _params
+from ..device.kernels import register_kernel, register_lazy_kernel
+from ..ptg.lowering import register_traceable
+
+_params.register("llm_use_pallas", False,
+                 "resolve the ragged decode page kernel through the Pallas "
+                 "build (interpret mode off-TPU) instead of the jnp body")
+
+NEG_INF = -1e30          # finite sentinel: exp(x - m) underflows to 0.0
+
+
+# ---------------------------------------------------------------------------
+# numpy incarnations (CPU task bodies)
+# ---------------------------------------------------------------------------
+
+def attn_page_update_np(q3: np.ndarray, page: np.ndarray,
+                        acc: np.ndarray) -> np.ndarray:
+    """Online-softmax update of one query against one KV page.
+
+    ``q3``: ``(3, H, D)`` — channel 0 the query (1,2 carry the token's
+    k/v for the append stage, unused here); ``page``: ``(3, P, H, D)``
+    K/V/meta with ``page[2,0,0,0]`` the fill count; ``acc``: ``(H, D+2)``.
+    """
+    H, Dp2 = acc.shape
+    D = Dp2 - 2
+    q = np.asarray(q3[0], np.float32)
+    k = np.asarray(page[0], np.float32)
+    v = np.asarray(page[1], np.float32)
+    fill = int(page[2, 0, 0, 0])
+    P = k.shape[0]
+    scores = np.einsum("phd,hd->ph", k, q) / np.sqrt(D)      # (P, H)
+    valid = (np.arange(P) < fill)[:, None]
+    scores = np.where(valid, scores, NEG_INF)
+    l_prev = acc[:, D + 1]
+    m_prev = np.where(l_prev > 0, acc[:, D], NEG_INF)
+    m_new = np.maximum(m_prev, scores.max(axis=0))
+    # explicit valid mask on the weights: with an all-empty page AND an
+    # empty accumulator m_new stays NEG_INF and exp(0)=1 would count the
+    # invalid slots
+    w = np.where(valid, np.exp(scores - m_new[None, :]), 0.0)
+    alpha = np.exp(m_prev - m_new)                           # <= 1
+    out = np.empty((H, Dp2), np.float32)
+    out[:, :D] = acc[:, :D] * alpha[:, None] + np.einsum("ph,phd->hd", w, v)
+    out[:, D] = m_new
+    out[:, D + 1] = l_prev * alpha + w.sum(axis=0)
+    return out
+
+
+def finalize_acc_np(acc: np.ndarray) -> np.ndarray:
+    """Normalize the flash state to the attention output ``(H, D)``;
+    an empty cache (``l == 0``) yields zeros, not NaN."""
+    D = acc.shape[1] - 2
+    l = acc[:, D + 1]
+    return np.where((l > 0)[:, None],
+                    acc[:, :D] / np.maximum(l, 1e-30)[:, None],
+                    0.0).astype(np.float32)
+
+
+def attn_out_np(acc: np.ndarray, q3: np.ndarray,
+                page: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """The decode epilog: finalize the attention output and append the
+    query token's k/v into the tail page at its fill slot.  Returns
+    ``(new_page, o)`` — a fresh page array (the home copy may still be
+    snapshotted by a reader)."""
+    o = finalize_acc_np(acc)
+    page = np.array(page, copy=True)
+    fill = int(page[2, 0, 0, 0])
+    page[0, fill] = q3[1]
+    page[1, fill] = q3[2]
+    page[2, 0, 0, 0] = fill + 1
+    return page, o
+
+
+def ragged_attention_reference(q: np.ndarray, ks: np.ndarray,
+                               vs: np.ndarray) -> np.ndarray:
+    """Dense single-shot oracle: softmax(q·K/sqrt(D))·V over an
+    unpaginated cache — what the paged online-softmax chain must equal."""
+    q = np.asarray(q, np.float64)
+    if len(ks) == 0:
+        return np.zeros_like(q, dtype=np.float32)
+    ks = np.asarray(ks, np.float64)
+    vs = np.asarray(vs, np.float64)
+    scores = np.einsum("nhd,hd->nh", ks, q) / np.sqrt(q.shape[-1])
+    scores -= scores.max(axis=0, keepdims=True)
+    w = np.exp(scores)
+    w /= w.sum(axis=0, keepdims=True)
+    return np.einsum("nh,nhd->hd", w, vs).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# jnp twins: traceables (vmapped same-class batching) + device bodies
+# ---------------------------------------------------------------------------
+
+def _page_update_jnp(q3: Any, page: Any, acc: Any) -> Any:
+    import jax.numpy as jnp
+    D = acc.shape[1] - 2
+    P = page.shape[1]
+    q = q3[0].astype(jnp.float32)
+    k = page[0].astype(jnp.float32)
+    v = page[1].astype(jnp.float32)
+    fill = page[2, 0, 0, 0]
+    scores = jnp.einsum("phd,hd->ph", k, q) / jnp.sqrt(jnp.float32(D))
+    valid = (jnp.arange(P) < fill)[:, None]
+    scores = jnp.where(valid, scores, NEG_INF)
+    l_prev = acc[:, D + 1]
+    m_prev = jnp.where(l_prev > 0, acc[:, D], NEG_INF)
+    m_new = jnp.maximum(m_prev, scores.max(axis=0))
+    w = jnp.where(valid, jnp.exp(scores - m_new[None, :]), 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    o = acc[:, :D] * alpha[:, None] + jnp.einsum("ph,phd->hd", w, v)
+    return jnp.concatenate(
+        [o, m_new[:, None], (l_prev * alpha + w.sum(axis=0))[:, None]],
+        axis=1).astype(jnp.float32)
+
+
+def _out_update_jnp(acc: Any, q3: Any, page: Any, o_scratch: Any) -> Any:
+    import jax.numpy as jnp
+    acc, page = jnp.asarray(acc), jnp.asarray(page)
+    D = acc.shape[1] - 2
+    l = acc[:, D + 1]
+    o = jnp.where((l > 0)[:, None],
+                  acc[:, :D] / jnp.maximum(l, 1e-30)[:, None], 0.0)
+    fill = page[2, 0, 0, 0].astype(jnp.int32)
+    page = page.at[0, fill].set(q3[1]).at[1, fill].set(q3[2])
+    page = page.at[2, 0, 0, 0].set((fill + 1).astype(page.dtype))
+    return page, o.astype(jnp.float32)
+
+
+register_traceable("ragged_attn_page", _page_update_jnp)
+register_traceable("ragged_attn_out", _out_update_jnp)
+
+
+# ---------------------------------------------------------------------------
+# Pallas seam: the arxiv-2604.15464 kernel slot
+# ---------------------------------------------------------------------------
+
+def build_pallas_page_update(interpret: bool = False) -> Any:
+    """One-page ragged attention as a Pallas kernel (whole tiles in VMEM
+    — decode pages are far under the VMEM budget; production shapes
+    would pad H·D to the (8, 128) f32 tile, /opt/skills/guides/
+    pallas_guide.md).  ``interpret=True`` runs it off-TPU."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(q_ref, page_ref, acc_ref, out_ref):
+        D = acc_ref.shape[1] - 2
+        P = page_ref.shape[1]
+        q = q_ref[0]                                     # (H, D)
+        k = page_ref[0]                                  # (P, H, D)
+        v = page_ref[1]
+        fill = page_ref[2, 0, 0, 0]
+        acc = acc_ref[:]
+        # VPU-shaped reduction: (P,H,D) * (H,D) summed over D
+        scores = jnp.sum(k * q[None], axis=-1) / jnp.sqrt(jnp.float32(D))
+        valid = (jax.lax.broadcasted_iota(jnp.int32, (P, 1), 0)
+                 < fill.astype(jnp.int32))
+        scores = jnp.where(valid, scores, NEG_INF)
+        l_prev = acc[:, D + 1]
+        m_prev = jnp.where(l_prev > 0, acc[:, D], NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=0))
+        w = jnp.where(valid, jnp.exp(scores - m_new[None, :]), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        o = acc[:, :D] * alpha[:, None] + jnp.sum(w[:, :, None] * v, axis=0)
+        out_ref[:, :D] = o
+        out_ref[:, D] = m_new
+        out_ref[:, D + 1] = l_prev * alpha + jnp.sum(w, axis=0)
+
+    @jax.jit
+    def page_update(q3, page, acc):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(acc.shape, jnp.float32),
+            interpret=interpret,
+        )(q3.astype(jnp.float32), page.astype(jnp.float32),
+          acc.astype(jnp.float32))
+
+    return page_update
+
+
+# ---------------------------------------------------------------------------
+# device bodies, resolved lazily (register_lazy_kernel: the loaders only
+# build jits — and possibly trace Pallas — on the first real dispatch)
+# ---------------------------------------------------------------------------
+
+def _load_page_body() -> Any:
+    import jax
+    if _params.get("llm_use_pallas"):
+        fn = build_pallas_page_update(
+            interpret=jax.default_backend() != "tpu")
+    else:
+        fn = jax.jit(_page_update_jnp)
+
+    def body(es: Any, task: Any, device: Any) -> Any:
+        acc = task.data[2]
+        acc.value = fn(task.data[0].value, task.data[1].value, acc.value)
+        acc.version += 1
+        return acc.value
+
+    return body
+
+
+def _load_out_body() -> Any:
+    import jax
+    fn = jax.jit(_out_update_jnp)
+
+    def body(es: Any, task: Any, device: Any) -> Any:
+        kvw, o = task.data[2], task.data[3]
+        new_page, out = fn(task.data[0].value, task.data[1].value,
+                           kvw.value, o.value)
+        kvw.value = new_page
+        kvw.version += 1
+        o.value = out
+        o.version += 1
+        return out
+
+    return body
+
+
+register_lazy_kernel("ragged_attn_page", "tpu", _load_page_body)
+register_lazy_kernel("ragged_attn_out", "tpu", _load_out_body)
+
+
+# CPU dyld entries (DTD bodies may name them; the PTG pools attach the
+# numpy bodies directly)
+
+def _page_body_cpu(es: Any, task: Any) -> None:
+    acc = task.data[2]
+    acc.value = attn_page_update_np(np.asarray(task.data[0].value),
+                                    np.asarray(task.data[1].value),
+                                    np.asarray(acc.value))
+    acc.version += 1
+
+
+def _out_body_cpu(es: Any, task: Any) -> None:
+    kvw, o = task.data[2], task.data[3]
+    new_page, out = attn_out_np(np.asarray(task.data[0].value),
+                                np.asarray(task.data[1].value),
+                                np.asarray(kvw.value))
+    kvw.value = new_page
+    kvw.version += 1
+    o.value = out
+    o.version += 1
+
+
+register_kernel("ragged_attn_page", "cpu", _page_body_cpu)
+register_kernel("ragged_attn_out", "cpu", _out_body_cpu)

@@ -107,6 +107,13 @@ class Taskpool:
             cb(self)
         if self.context is not None:
             self.context._taskpool_terminated(self)
+        # retire from the process registry: a serving workload enqueues a
+        # fresh pool per iteration (the LLM continuous batcher builds one
+        # decode pool per token batch), and an insert-only registry would
+        # grow by every pool the process EVER ran.  taskpool_lookup is a
+        # live-pool lookup; the pool object itself stays valid for its
+        # holders (tickets, wait()).
+        _registry.remove(self.taskpool_id)
 
     def wait(self, timeout: float | None = None) -> None:
         """``parsec_taskpool_wait`` — block until this taskpool completes.

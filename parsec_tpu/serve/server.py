@@ -215,6 +215,7 @@ class RuntimeServer:
         self.failed = 0
         self.rejected = 0
         self.per_tenant_completed: dict[str, int] = {}
+        self._llm = None            # lazy ContinuousBatcher (submit_stream)
         self._ctx.add_failure_listener(self._on_context_failure)
         self._ctx.start()
 
@@ -356,6 +357,29 @@ class RuntimeServer:
         kw.setdefault("result_fn", lambda _tp: out["stores"])
         return self.submit(p.build(), **kw)
 
+    def submit_stream(self, prompt_tokens, *, max_new_tokens: int = 16,
+                      tenant: str = "default", priority: int = 0):
+        """Open an LLM generation stream — the session abstraction over
+        this server's continuous batcher (``parsec_tpu/llm/batcher.py``;
+        ``docs/LLM.md``).  The first call creates the batcher (paged KV
+        cache + decode loop thread); every stream then rides the
+        iteration-level batch: per-step decode pools submitted under the
+        stream's ``tenant``, so WFQ arbitrates decode against any other
+        workload this server carries.  Returns a
+        :class:`~parsec_tpu.llm.batcher.StreamTicket`."""
+        with self._lock:
+            if self._draining or self._poison is not None:
+                raise AdmissionRejected(
+                    "server is draining" if self._poison is None
+                    else "server context is poisoned")
+            if self._llm is None:
+                from ..llm.batcher import ContinuousBatcher
+                self._llm = ContinuousBatcher(self)
+            llm = self._llm
+        return llm.submit_stream(prompt_tokens,
+                                 max_new_tokens=max_new_tokens,
+                                 tenant=tenant, priority=priority)
+
     # -- completion / failure -------------------------------------------
     def _on_pool_done(self, tp: Taskpool) -> None:
         sub: _Submission = tp._serve_sub
@@ -410,6 +434,14 @@ class RuntimeServer:
         remaining tickets fail with :class:`ContextWaitTimeout` and the
         context tears down abort-style (stall dump fires) — the server is
         DOWN either way when this returns/raises."""
+        with self._lock:
+            llm = self._llm
+        if llm is not None:
+            # the batcher submits a pool per decode iteration: let its
+            # live streams finish (bounded) BEFORE admission closes, or
+            # every mid-generation stream would shed at the door.  stop()
+            # is join-idempotent, so concurrent drains may both call it.
+            llm.stop(timeout=timeout)
         with self._lock:
             first = not self._draining
             self._draining = True
@@ -478,7 +510,11 @@ class RuntimeServer:
 
     def stats(self) -> dict:
         with self._lock:
+            llm = self._llm
+        extra = {"llm": llm.stats()} if llm is not None else {}
+        with self._lock:
             return {
+                **extra,
                 "submitted": self.submitted,
                 "completed": self.completed,
                 "failed": self.failed,

@@ -29,6 +29,10 @@ if [[ "${1:-}" == "--fast" ]]; then
 else
     echo "== runtimelint + graphcheck (every shipped model graph) =="
     python -m parsec_tpu.analysis
+
+    echo "== llm microbench (smoke: tokens/s through the serving stack) =="
+    python -c 'import json, microbench; \
+print(json.dumps(microbench.bench_llm(smoke=True)))'
 fi
 
 echo "check.sh: all stages green"

@@ -131,6 +131,49 @@ def test_serve_stage_reports_throughput_and_warm_cache(smoke_run):
         max(sv["serve_lowered_cold_s"], 0.05)
 
 
+def test_llm_stage_reports_tokens_per_s_and_sweep(smoke_run):
+    """The LLM serving stage (ISSUE 6) ships tokens/s, per-token p50/p99,
+    and the concurrent-streams sweep axis."""
+    last = _json_lines(smoke_run[0].stdout)[-1]
+    llm = last["extra"]["llm"]
+    assert llm["llm_tokens_per_s"] > 0
+    assert llm["llm_p99_ms"] >= llm["llm_p50_ms"] > 0
+    sweep = llm["llm_streams_sweep"]
+    assert len(sweep) >= 2 and all(
+        v["tokens_per_s"] > 0 for v in sweep.values()), llm
+
+
+def test_compile_deadline_death_records_typed_partial_entry():
+    """The BENCH_r04/r05 failure shape (ISSUE 6 satellite): a stage dying
+    on its deadline mid-compile must degrade to a
+    ``{"status": "compile_timeout"}`` record carrying the partial
+    metrics it flushed — not vanish into a bare timeout."""
+    import bench
+
+    def fake_compile_stage():
+        bench._note_partial(phase="compile", lowering_mode="wavefront")
+        time.sleep(30)
+
+    prior = list(bench._abandoned)
+    try:
+        res = bench._staged("fakechol", fake_compile_stage, timeout=0.3)
+        assert res["status"] == "compile_timeout", res
+        assert res["partial"]["lowering_mode"] == "wavefront", res
+        assert res["gflops"] == 0.0 and "error" in res
+
+        # past the compile phase, the same death is a plain timeout —
+        # but the flushed compile seconds survive into the record
+        def fake_measure_stage():
+            bench._note_partial(phase="measure", compile_s=3.2)
+            time.sleep(30)
+
+        res = bench._staged("fakemeasure", fake_measure_stage, timeout=0.3)
+        assert res["status"] == "timeout", res
+        assert res["partial"]["compile_s"] == 3.2, res
+    finally:
+        bench._abandoned[:] = prior
+
+
 def test_lowered_stages_report_compile_seconds(smoke_run):
     last = _json_lines(smoke_run[0].stdout)[-1]
     assert last["extra"]["lowered_cholesky_compile_s"] > 0

@@ -1,6 +1,7 @@
-"""The Ex00–Ex11 examples ladder is living documentation: every script
+"""The Ex00–Ex12 examples ladder is living documentation: every script
 must keep running and self-checking (reference examples/ + SURVEY §2.11;
-Ex11 is the serving-layer demo, parsec_tpu/serve/)."""
+Ex11 is the serving-layer demo, parsec_tpu/serve/; Ex12 the LLM
+continuous-batching demo, parsec_tpu/llm/)."""
 
 import importlib.util
 import pathlib
@@ -20,7 +21,7 @@ def load(path):
 
 def test_ladder_is_complete():
     assert [p.stem.split("_")[0] for p in EXAMPLES] == \
-        [f"Ex{i:02d}" for i in range(12)]
+        [f"Ex{i:02d}" for i in range(13)]
 
 
 @pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: p.stem)

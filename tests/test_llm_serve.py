@@ -1,0 +1,131 @@
+"""Multi-tenant isolation soak: LLM decode streams sharing one
+RuntimeServer with a dense-linear-algebra tenant (ISSUE 6 satellite).
+
+The serving claim under test: WFQ keeps interactive decode responsive
+while a batch factorization grinds on the same workers — decode p99
+stays bounded, both tenants make progress, and the generated tokens
+still match the dense oracle exactly (fairness must never reorder a
+sequence's own chain)."""
+
+import threading
+import time
+
+import numpy as np
+
+from parsec_tpu.llm import ToyLM
+from parsec_tpu.serve import RuntimeServer
+
+MODEL = ToyLM()
+
+# interactive decode gets a 4x fair share over the batch tenant; the
+# p99 bound is ~100x the unloaded per-token latency (~5ms on 2 CPU
+# workers) — loose enough for CI noise, tight enough that a fairness
+# regression that parks decode behind a whole factorization (hundreds
+# of ms per pool) trips it
+DECODE_P99_S_MAX = 1.0
+
+
+def _cholesky_pool(n=96, nb=32):
+    from parsec_tpu.data_dist.matrix import SymTwoDimBlockCyclic
+    from parsec_tpu.models.cholesky import make_spd, tiled_cholesky_ptg
+    A = SymTwoDimBlockCyclic.from_dense("A", make_spd(n), nb, nb)
+    return tiled_cholesky_ptg(A, devices="cpu"), A
+
+
+def test_decode_streams_isolated_from_batch_cholesky_tenant():
+    with RuntimeServer(nb_cores=2, tenant_weights={"chat": 4.0,
+                                                   "batch": 1.0}) as server:
+        prompts = [[3, 7, 11, 5], [1, 40], [8, 30, 22]]
+        streams = [server.submit_stream(p, max_new_tokens=12,
+                                        tenant="chat")
+                   for p in prompts]
+        # the batch tenant keeps a cholesky pool in flight until every
+        # stream finishes — decode always contends with dense work
+        done = threading.Event()
+        batch_completed = [0]
+        batch_errors: list[BaseException] = []
+
+        def batch_client():
+            try:
+                while not done.is_set():
+                    tp, _A = _cholesky_pool()
+                    server.submit(tp, tenant="batch").result(timeout=120)
+                    batch_completed[0] += 1
+            except BaseException as e:      # noqa: BLE001 — surfaced below
+                batch_errors.append(e)
+
+        th = threading.Thread(target=batch_client, daemon=True)
+        th.start()
+        try:
+            per_token = []
+            for p, tk in zip(prompts, streams):
+                r = tk.result(timeout=300)
+                assert r["tokens"] == MODEL.reference_generate(p, 12), p
+                per_token += r["per_token_s"]
+        finally:
+            done.set()
+            th.join(timeout=300)
+        assert not batch_errors, batch_errors
+        # both tenants made progress under contention
+        assert batch_completed[0] >= 1
+        stats = server.stats()
+        disp = stats["fair_dispatched"]
+        assert disp.get("chat", 0) > 0 and disp.get("batch", 0) > 0, disp
+        # decode latency stayed bounded while the batch job ran
+        per_token.sort()
+        p99 = per_token[min(int(len(per_token) * 0.99),
+                            len(per_token) - 1)]
+        assert p99 <= DECODE_P99_S_MAX, (p99, stats)
+        # WFQ virtual time favored chat 4:1: its decode pools completed
+        # (12 tokens x 3 streams) despite the saturating batch tenant
+        assert stats["per_tenant_completed"].get("chat", 0) >= 12
+
+
+def test_drain_finishes_live_streams_then_stops_admission():
+    server = RuntimeServer(nb_cores=2)
+    tk = server.submit_stream([3, 7, 11], max_new_tokens=6, tenant="chat")
+    time.sleep(0.05)                 # let a few iterations land
+    server.drain(timeout=120)
+    r = tk.result(timeout=5)         # drain waited for the stream
+    assert r["tokens"] == MODEL.reference_generate([3, 7, 11], 6)
+    assert server.stats()["llm"]["live_streams"] == 0
+
+
+def test_stream_failure_is_contained_to_its_streams():
+    """A poisoned/draining server fails stream tickets promptly instead
+    of leaving clients blocked on result()."""
+    server = RuntimeServer(nb_cores=1)
+    tk = server.submit_stream([1, 2], max_new_tokens=2)
+    tk.result(timeout=60)
+    # after the graceful drain the batcher thread is gone; a fresh
+    # submit_stream sheds instead of queueing forever
+    server.drain(timeout=60)
+    from parsec_tpu.serve import AdmissionRejected
+    import pytest
+    with pytest.raises(AdmissionRejected):
+        server.submit_stream([1, 2])
+
+
+def test_forked_prefix_shares_physical_pages_across_streams():
+    """Prefix sharing through the batcher's cache: two sequences forked
+    from one prompt dedupe their prompt pages (the paged-KV win)."""
+    from parsec_tpu.llm import ContinuousBatcher, PagedKVCollection
+    with RuntimeServer(nb_cores=2) as server:
+        kv = PagedKVCollection("KV", page_size=4,
+                               num_heads=MODEL.num_heads,
+                               head_dim=MODEL.head_dim)
+        b = ContinuousBatcher(server, model=MODEL, kv=kv)
+        # materialize a parent sequence's pages via one short stream,
+        # then fork the cache state directly (the collection API — the
+        # batcher session layer for fork-on-prompt can build on it)
+        kv.alloc_seq("p")
+        from parsec_tpu.llm import prefill_chunks
+        chunks = prefill_chunks(MODEL, kv, "p", [3, 7, 11, 5, 9])
+        for (s, c), tile in chunks.items():
+            pg = kv.data_of(s, c).get_copy(0)
+            pg.value = tile
+            pg.version += 1
+        kv.fork("p", "q")
+        st = kv.stats()
+        assert st["logical_pages"] == 4 and st["physical_pages"] == 2
+        b.stop()
