@@ -20,6 +20,11 @@ measurable even when the TPU relay is dark:
 - ``bench_lowering_cache``     — first-vs-second compile seconds of an
   identical lowered taskpool (the persistent lowering cache,
   ptg/lowering.py);
+- ``bench_lowering``           — XLA calls per DAG and trace/compile
+  seconds across the lowering modes (ISSUE 8): dynamic task-per-dispatch
+  vs megakernel regions vs whole-pool wavefront/scan vs chain-collapse,
+  on cholesky's irregular 4-class DAG (docs/PERF.md, "Region lowering &
+  compile budgets");
 - ``bench_serve``              — sustained submissions/s and p50/p99
   ticket latency through a RuntimeServer: concurrent client threads,
   two tenants, one hot context (the serving layer, parsec_tpu/serve/);
@@ -216,6 +221,111 @@ def bench_lowering_cache(n: int = 96, nb: int = 32) -> dict:
             "compile_warm_s": round(warm, 4),
             "cache_hits": lowering_cache.hits - h0,
             "cache_misses": lowering_cache.misses - m0}
+
+
+def bench_lowering(n: int = 256, nb: int = 32, smoke: bool = False) -> dict:
+    """XLA calls per DAG + trace/compile seconds across the lowering modes
+    (ISSUE 8, the MPK axis): on cholesky's irregular 4-class DAG, compare
+    the dynamic task-per-dispatch path (vmapped batching OFF — every task
+    is one XLA dispatch, the boundary cost megakernels delete) against the
+    region lowering (one jitted program per convex subgraph), plus the
+    whole-pool wavefront/scan emission and the GEMM chain-collapse for the
+    per-mode compile-cost axis.  Every number is CPU-measurable; the
+    dispatch counts come from the process-wide ledger feeding both paths
+    (``device.note_xla_calls``)."""
+    import jax
+    import numpy as np
+
+    from parsec_tpu.core.params import params
+    from parsec_tpu.data_dist.matrix import SymTwoDimBlockCyclic, TiledMatrix
+    from parsec_tpu.device import registry
+    from parsec_tpu.device.device import xla_calls_total
+    from parsec_tpu.device.tpu import TPUDevice
+    from parsec_tpu.models.cholesky import make_spd, tiled_cholesky_ptg
+    from parsec_tpu.models.tiled_gemm import tiled_gemm_ptg
+    from parsec_tpu.ptg.lowering import lower_regions, lower_taskpool
+    from parsec_tpu.runtime import Context
+
+    if smoke:
+        n, nb = 128, 32
+    a = make_spd(n)
+
+    def chol(devices="auto"):
+        A = SymTwoDimBlockCyclic.from_dense("A", a.copy(), nb, nb)
+        return tiled_cholesky_ptg(A, devices=devices)
+
+    out: dict = {"lowering_n": n, "lowering_nb": nb}
+
+    # --- task-per-dispatch baseline: the dynamic device path, vmapped
+    # batching disabled, so EVERY task body is one XLA enqueue ---
+    snapshot = list(registry.devices)
+    saved_batch = params.get("device_tpu_batch")
+    params.set("device_tpu_batch", False)
+    dev = TPUDevice(jax.devices()[0])
+    registry.add(dev)
+    try:
+        tp = chol(devices="tpu")
+        ledger0, tasks0 = xla_calls_total(), dev.executed_tasks
+        ctx = Context(nb_cores=0)
+        try:
+            ctx.add_taskpool(tp)
+            ctx.wait(timeout=120)
+            dev.sync()
+        finally:
+            ctx.fini(timeout=30)
+        out["lowering_tasks_per_dag"] = dev.executed_tasks - tasks0
+        out["lowering_dispatch_xla_calls"] = xla_calls_total() - ledger0
+    finally:
+        params.set("device_tpu_batch", saved_batch)
+        registry.devices = snapshot
+        for i, d in enumerate(registry.devices):
+            d.device_index = i
+
+    # --- region mode: one program per verified subgraph, cold then warm
+    # (the second structurally identical plan must hit the process cache
+    # and report ~0 compile seconds — the AOT-warming contract) ---
+    plan = lower_regions(chol())
+    plan.compile()
+    cold = plan.stats()
+    ledger0 = xla_calls_total()
+    plan.execute()
+    st = plan.stats()
+    out["lowering_region_count"] = st["regions"]
+    # the same process-wide ledger as the dispatch baseline above, so
+    # the two counts are one comparable axis; the plan's own counter
+    # rides along as the cross-check (they diverge only if another
+    # thread dispatched concurrently)
+    out["lowering_region_xla_calls"] = xla_calls_total() - ledger0
+    out["lowering_region_plan_xla_calls"] = st["xla_calls"]
+    out["lowering_region_trace_s"] = cold["trace_s"]
+    out["lowering_region_compile_cold_s"] = cold["compile_s"]
+    warm = lower_regions(chol())
+    warm.compile()
+    out["lowering_region_compile_warm_s"] = warm.stats()["compile_s"]
+    if out["lowering_region_xla_calls"]:
+        out["lowering_region_xla_call_drop"] = round(
+            out["lowering_dispatch_xla_calls"] / out["lowering_region_xla_calls"], 1)
+
+    # --- whole-pool wavefront (scan-folded) emission: ONE program ---
+    low = lower_taskpool(chol(), passes="wavefront")
+    out["lowering_wavefront_xla_calls"] = 1
+    wavefront = low.warm()
+    out["lowering_wavefront_trace_s"] = wavefront["trace_s"]
+    out["lowering_wavefront_compile_s"] = wavefront["compile_s"]
+
+    # --- chain-collapse: the GEMM k-chain as one contraction ---
+    gn, gnb = (64, 32) if smoke else (128, 32)
+    rng = np.random.default_rng(3)
+    g = rng.standard_normal((gn, gn)).astype(np.float32)
+    A = TiledMatrix.from_dense("A", g.copy(), gnb, gnb)
+    B = TiledMatrix.from_dense("B", g.copy(), gnb, gnb)
+    C = TiledMatrix.from_dense("C", np.zeros((gn, gn), np.float32), gnb, gnb)
+    low = lower_taskpool(tiled_gemm_ptg(A, B, C), passes="chain-collapse")
+    out["lowering_chain_xla_calls"] = 1
+    chain = low.warm()
+    out["lowering_chain_trace_s"] = chain["trace_s"]
+    out["lowering_chain_compile_s"] = chain["compile_s"]
+    return out
 
 
 def bench_serve(nsub: int = 64, nthreads: int = 4, depth: int = 8,
@@ -564,6 +674,10 @@ def run_all(smoke: bool = False, include_lowering: bool = True,
             out.update(bench_lowering_cache())
         except Exception as e:            # noqa: BLE001 — evidence over abort
             out["lowering_cache_error"] = f"{type(e).__name__}: {e}"
+        try:
+            out.update(bench_lowering(smoke=smoke))
+        except Exception as e:            # noqa: BLE001 — evidence over abort
+            out["lowering_bench_error"] = f"{type(e).__name__}: {e}"
     return out
 
 

@@ -34,11 +34,13 @@ re-exported here so there is one analysis namespace.
 
 from .graphcheck import (Finding, GraphCheckError, GraphReport, check_dtd,
                          check_jdf, check_ptg, check_taskpool)
+from .regions import Region, select_regions, task_levels
 from .runtimelint import LintReport, lint_file, lint_paths, lint_self
 
 __all__ = [
     "Finding", "GraphCheckError", "GraphReport",
     "check_taskpool", "check_ptg", "check_dtd", "check_jdf",
+    "Region", "select_regions", "task_levels",
     "LintReport", "lint_file", "lint_paths", "lint_self",
     "IteratorsCheckerError", "check_task",
 ]

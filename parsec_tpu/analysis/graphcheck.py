@@ -130,6 +130,11 @@ class GraphReport:
         self.ntasks = 0
         self.nedges = 0
         self.truncated = False
+        # the concrete task graph the edge walk materialized:
+        # (class, key) -> successor nodes.  Retained so downstream
+        # consumers (region selection, ptg/lowering.lower_regions) work
+        # off the VERIFIED execution space instead of re-enumerating.
+        self.graph: dict[tuple, list[tuple]] = {}
         self._seen: dict[tuple, Finding] = {}
 
     def add(self, code: str, severity: str, message: str,
@@ -162,6 +167,18 @@ class GraphReport:
         if not self.ok:
             raise GraphCheckError(self)
         return self
+
+    def select_regions(self, max_tasks: int = 0) -> list:
+        """Carve the verified concrete task graph into maximal acyclic
+        subregions (:mod:`parsec_tpu.analysis.regions`): convex
+        wavefront-level bands per weakly-connected component, capped at
+        ``max_tasks`` members (0 = unbounded).  The megakernel lowering
+        (``ptg/lowering.lower_regions``) compiles one XLA program per
+        region.  Raises on a truncated or failing report — regions over
+        an unverified graph could hide the hazards this report exists
+        to surface."""
+        from .regions import regions_of_report
+        return regions_of_report(self, max_tasks=max_tasks)
 
     def summary(self) -> str:
         state = "OK" if self.ok else "FAILED"
@@ -502,6 +519,7 @@ def check_ptg(tp: Any, nb_ranks: int | None = None,
                 any_ready = True
 
     report.nedges = sum(len(v) for v in adj.values())
+    report.graph = adj
 
     # ---- phase 3: class-level structure ----------------------------------
     for tc in tp.task_classes:

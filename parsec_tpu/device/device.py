@@ -17,6 +17,32 @@ from typing import Any
 from ..core.params import params as _params
 from ..core.info import InfoObjectArray
 
+# ---------------------------------------------------------------------------
+# process-wide XLA dispatch ledger
+#
+# Every accelerator enqueue in the process — the dynamic device path's
+# per-task (or vmapped-batch) dispatches (device/tpu.py) AND the lowered
+# paths' whole-program / per-region invocations (ptg/lowering.py) — bumps
+# ONE counter, so "XLA calls per DAG" is a single comparable axis across
+# execution modes (microbench.bench_lowering; the MPK ≥5x dispatch-drop
+# acceptance gate reads it).  A plain int under a lock: this is per
+# dispatch (≥ µs of enqueue work), not per task.
+# ---------------------------------------------------------------------------
+
+_xla_lock = threading.Lock()
+_xla_calls = 0
+
+
+def note_xla_calls(n: int = 1) -> None:
+    global _xla_calls
+    with _xla_lock:
+        _xla_calls += n
+
+
+def xla_calls_total() -> int:
+    with _xla_lock:
+        return _xla_calls
+
 
 class Device:
     """Base device module (cf. ``parsec_device_module_t``)."""

@@ -38,7 +38,7 @@ from ..data.data import (COHERENCY_EXCLUSIVE, COHERENCY_INVALID,
 from ..prof import pins
 from ..prof.pins import PinsEvent
 from ..runtime.task import HOOK_RETURN_ASYNC
-from .device import Device, registry
+from .device import Device, note_xla_calls, registry
 
 _params.register("device_tpu_memory_use", 90,
                  "percent of per-device HBM the tile cache may use")
@@ -556,6 +556,7 @@ class TPUDevice(Device):
             for dtask in batch:   # exec phase (exec streams analog)
                 out = dtask.submit(dtask.es, dtask.task, self)
                 self.xla_calls += 1
+                note_xla_calls(1)
                 self._note_inflight(out)
                 self.executed_tasks += 1
                 self._mark_written(dtask.task)
@@ -659,6 +660,7 @@ class TPUDevice(Device):
             self._dispatch_hook(batch)
         outs = fn(*flat)
         self.xla_calls += 1              # the whole batch, one enqueue
+        note_xla_calls(1)
         assert len(outs) == len(written), (dyld, len(outs), len(written))
         self._note_inflight(outs)
         for w, parts in zip(written, outs):

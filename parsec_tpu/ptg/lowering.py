@@ -49,6 +49,7 @@ import itertools
 import os
 import tempfile
 import threading
+import time
 from typing import Any, Callable
 
 import numpy as np
@@ -57,7 +58,9 @@ from ..core.params import params as _params
 from ..data.data import ACCESS_RW, ACCESS_WRITE
 
 __all__ = ["LoweringError", "register_traceable", "find_traceable",
-           "lower_taskpool", "LoweredTaskpool", "lowering_cache"]
+           "lower_taskpool", "LoweredTaskpool", "lowering_cache",
+           "lower_regions", "RegionLoweredTaskpool", "LoweredRegion",
+           "warm_cache"]
 
 _params.register(
     "lowering_scan_min", 4,
@@ -75,7 +78,23 @@ _params.register(
                    os.path.join(tempfile.gettempdir(),
                                 "parsec-tpu-xla-cache")),
     "directory for JAX's persistent compilation cache (survives process "
-    "restarts and relay flaps); empty disables it")
+    "restarts and relay flaps); a per-(jax version, backend) subdirectory "
+    "is appended so CPU and TPU processes sharing the dir can never serve "
+    "each other stale executables; empty disables it")
+_params.register(
+    "lowering_region_max_tasks", 256,
+    "member cap per megakernel region (analysis.regions): regions are "
+    "convex wavefront-level bands of the verified task graph, one jitted "
+    "XLA program each — smaller regions mean cheaper per-region compiles "
+    "under lowering_compile_budget_s, more runtime boundaries; 0 lowers "
+    "each weakly-connected component whole")
+_params.register(
+    "lowering_compile_budget_s", 0.0,
+    "wall-clock budget for staged region compilation (smallest region "
+    "first): once the budget is spent, remaining regions fall back to "
+    "the eager (uncompiled, op-by-op) path instead of risking a stage "
+    "deadline death mid-XLA-compile (BENCH_r04/r05, rc 124); cache hits "
+    "are always free; 0.0 = unbudgeted")
 
 
 class LoweringError(RuntimeError):
@@ -196,6 +215,15 @@ class LoweringCache:
         self.hits = 0
         self.misses = 0
 
+    def peek(self, key) -> Any:
+        """Probe without building (no hit/miss accounting): the compile-
+        budget layer asks "is this region already paid for?" before
+        deciding whether the budget can afford a fresh compile."""
+        if key is None:
+            return None
+        with self._lock:
+            return self._jitted.get(key)
+
     def get_or_build(self, key, build: Callable[[], Any]):
         if key is None:
             return build()
@@ -226,13 +254,32 @@ class LoweringCache:
 
 lowering_cache = LoweringCache()
 
+
+def _backend_signature() -> tuple:
+    """The (jax version, backend, device kind) triple folded into every
+    executable cache key: an in-process cache consulted after a backend
+    flip (JAX_PLATFORMS override mid-process, tests forcing cpu) and a
+    compile-cache directory shared across CPU/TPU processes must never
+    serve an executable compiled for the other backend."""
+    import jax
+    try:
+        kind = getattr(jax.devices()[0], "device_kind", "")
+    except Exception:
+        kind = ""
+    return (jax.__version__, jax.default_backend(), kind)
+
+
 _pcache_done = False
 
 
 def _ensure_persistent_compile_cache() -> None:
     """Point JAX's persistent compilation cache at a durable directory
     (once per process): identical XLA programs then load from disk across
-    processes — a relay flap mid-run no longer discards compiled work.
+    processes — a relay flap mid-run no longer discards compiled work, and
+    the AOT cache-warming entry point (``python -m parsec_tpu.ptg.lowering
+    --warm``) pre-pays the compile before a bench stage's clock starts.
+    The directory gets a per-(jax version, backend) leaf so CPU and TPU
+    processes sharing PARSEC_TPU_COMPILE_CACHE_DIR stay isolated.
     Best-effort: an older jax without the knobs just skips it."""
     global _pcache_done
     if _pcache_done:
@@ -243,6 +290,7 @@ def _ensure_persistent_compile_cache() -> None:
         return
     try:
         import jax
+        d = os.path.join(d, f"{jax.__version__}-{jax.default_backend()}")
         jax.config.update("jax_compilation_cache_dir", d)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
@@ -300,10 +348,16 @@ def _analyze(tp) -> dict[str, _ClassInfo]:
                         f"{tc.name}.{f.name}: typed dep edges "
                         f"([type=...]) reshape on the dynamic path")
             for d in f.deps_in:
-                if d.target_class is None and d.data_ref is None:
-                    raise LoweringError(
-                        f"{tc.name}.{f.name}: NEW/NULL input arrows "
-                        f"resolve on the dynamic path")
+                if d.target_class is None and d.data_ref is None \
+                        and not d.null:
+                    # NEW arrow: the lowering allocates the scratch — a
+                    # zeros tile of the declared type, matching the
+                    # dynamic path's prepare_input allocation — so the
+                    # type must be statically known
+                    if d.dtt is None and f.dtt is None:
+                        raise LoweringError(
+                            f"{tc.name}.{f.name}: NEW input without a "
+                            f"declared tile type (pass dtt=)")
         infos[tc.name] = _ClassInfo(tc, tasks, kernel)
     return infos
 
@@ -344,44 +398,118 @@ class _Stores:
         self.nranks = nranks
         self.nrows: dict[str, int] = {}     # total rows incl. padding
         self.replicated: set[str] = set()   # nodes==1 collections
+        self.shape: dict[str, tuple] = {}   # uniform tile shape per store
+        self.dtype: dict[str, Any] = {}
+        self.open: set[str] = set()         # lazily-extended key spaces
+        self.scratch: set[str] = set()      # synthetic NEW-flow stores
+
+    def _ensure(self, dc) -> None:
+        name = dc.name
+        if name in self.dcs:
+            return
+        try:
+            keys = _collection_keys(dc)
+        except LoweringError:
+            keys = []                   # non-enumerable: open key space
+        if not keys:
+            # open store (paged-KV block tables, writeback-only dict
+            # collections): rows materialize on first reference through
+            # the collection's own has_key/data_of oracles
+            if self.nranks is not None:
+                raise LoweringError(
+                    f"collection {name}: open key spaces do not lower "
+                    f"multi-rank (no enumerable ownership)")
+            self.dcs[name] = dc
+            self.rows[name] = {}
+            self.nrows[name] = 0
+            self.layout[name] = "stacked"
+            self.open.add(name)
+            return
+        shapes = {dc.tile_shape(*k) if hasattr(dc, "tile_shape")
+                  else np.asarray(dc.data_of(*k).newest_copy().value).shape
+                  for k in keys}
+        if len(shapes) != 1:
+            raise LoweringError(
+                f"collection {name} has ragged tiles {shapes}; "
+                f"lowering needs uniform tile shapes")
+        self.dcs[name] = dc
+        if self.nranks is not None and getattr(dc, "nodes", 1) > 1:
+            if dc.nodes != self.nranks:
+                raise LoweringError(
+                    f"collection {name} is distributed over {dc.nodes} "
+                    f"ranks but the mesh has {self.nranks}")
+            by_rank: dict[int, list[tuple]] = {}
+            for k in keys:
+                by_rank.setdefault(dc.rank_of(*k), []).append(k)
+            cap = max(len(v) for v in by_rank.values())
+            rows: dict[tuple, int] = {}
+            for r in range(self.nranks):
+                for i, k in enumerate(by_rank.get(r, ())):
+                    rows[k] = r * cap + i
+            self.rows[name] = rows
+            self.nrows[name] = self.nranks * cap
+        else:
+            self.rows[name] = {k: i for i, k in enumerate(keys)}
+            self.nrows[name] = len(keys)
+            if self.nranks is not None:
+                self.replicated.add(name)
+        self.layout[name] = "stacked"
+        if hasattr(dc, "tile_shape") and getattr(dc, "dtype", None) \
+                is not None:
+            # declared geometry: planning (and the AOT warm path, whose
+            # contract is "no tile materialized") stays allocation-free
+            self.shape[name] = tuple(next(iter(shapes)))
+            self.dtype[name] = np.dtype(dc.dtype)
+        else:
+            first = np.asarray(dc.data_of(*keys[0]).newest_copy().value)
+            self.shape[name] = tuple(first.shape)
+            self.dtype[name] = first.dtype
 
     def row(self, dc, key: tuple) -> int:
+        self._ensure(dc)
         name = dc.name
-        if name not in self.dcs:
-            keys = _collection_keys(dc)
-            shapes = {dc.tile_shape(*k) if hasattr(dc, "tile_shape")
-                      else np.asarray(dc.data_of(*k).newest_copy().value).shape
-                      for k in keys}
-            if len(shapes) != 1:
+        r = self.rows[name].get(key)
+        if r is not None:
+            return r
+        # lazy extension: legal only when the collection itself vouches
+        # for the key (open dict stores answer has_key=True; a tiled
+        # grid's out-of-bounds key stays a hard error)
+        if name in self.open and getattr(dc, "has_key",
+                                         lambda *k: False)(*key):
+            val = np.asarray(dc.data_of(*key).newest_copy().value)
+            shape = self.shape.setdefault(name, tuple(val.shape))
+            self.dtype.setdefault(name, val.dtype)
+            if tuple(val.shape) != shape:
                 raise LoweringError(
-                    f"collection {name} has ragged tiles {shapes}; "
-                    f"lowering needs uniform tile shapes")
-            self.dcs[name] = dc
-            if self.nranks is not None and getattr(dc, "nodes", 1) > 1:
-                if dc.nodes != self.nranks:
-                    raise LoweringError(
-                        f"collection {name} is distributed over {dc.nodes} "
-                        f"ranks but the mesh has {self.nranks}")
-                by_rank: dict[int, list[tuple]] = {}
-                for k in keys:
-                    by_rank.setdefault(dc.rank_of(*k), []).append(k)
-                cap = max(len(v) for v in by_rank.values())
-                rows: dict[tuple, int] = {}
-                for r in range(self.nranks):
-                    for i, k in enumerate(by_rank.get(r, ())):
-                        rows[k] = r * cap + i
-                self.rows[name] = rows
-                self.nrows[name] = self.nranks * cap
-            else:
-                self.rows[name] = {k: i for i, k in enumerate(keys)}
-                self.nrows[name] = len(keys)
-                if self.nranks is not None:
-                    self.replicated.add(name)
-            self.layout[name] = "stacked"
-        try:
-            return self.rows[name][key]
-        except KeyError:
-            raise LoweringError(f"{name}: key {key} outside the store")
+                    f"collection {name}: ragged tiles "
+                    f"({tuple(val.shape)} vs {shape}); lowering needs "
+                    f"uniform tile shapes")
+            r = self.nrows[name]
+            self.rows[name][key] = r
+            self.nrows[name] = r + 1
+            return r
+        raise LoweringError(f"{name}: key {key} outside the store")
+
+    def scratch_row(self, cname: str, fname: str, key: tuple,
+                    shape: tuple, dtype: Any) -> tuple[str, int]:
+        """A row in the synthetic zero-initialized store backing a NEW
+        arrow (the compiled analog of ``scratch_copy``): RW flows whose
+        value never lands in a collection still need a store-resident
+        home so successors can gather it."""
+        name = f"_scratch_{cname}_{fname}"
+        if name not in self.rows:
+            self.rows[name] = {}
+            self.nrows[name] = 0
+            self.layout[name] = "scratch"
+            self.shape[name] = tuple(shape)
+            self.dtype[name] = np.dtype(dtype)
+            self.scratch.add(name)
+        r = self.rows[name].get(key)
+        if r is None:
+            r = self.nrows[name]
+            self.rows[name][key] = r
+            self.nrows[name] = r + 1
+        return name, r
 
     def is_dense_grid(self, dc, I: np.ndarray) -> bool:
         """Whether index grid ``I`` is exactly the identity tile grid of the
@@ -406,19 +534,46 @@ class _Stores:
     def materialize(self) -> dict[str, Any]:
         """Gather tiles into host arrays (device placement is the caller's
         business — jit will device_put on first call).  Rank-major stores
-        zero-fill their padding rows."""
+        zero-fill their padding rows; scratch stores materialize as zeros
+        (the NEW-arrow allocation policy, ``data.scratch_copy``)."""
         out = {}
         for name, dc in self.dcs.items():
             if self.layout[name] == "dense":
                 out[name] = dc.to_dense()
                 continue
             rows = self.rows[name]
+            if not rows:
+                continue            # ensured but never referenced
             first = np.asarray(
                 dc.data_of(*next(iter(rows))).newest_copy().value)
             arr = np.zeros((self.nrows[name],) + first.shape, first.dtype)
             for k, i in rows.items():
                 arr[i] = np.asarray(dc.data_of(*k).newest_copy().value)
             out[name] = arr
+        for name in self.scratch:
+            out[name] = np.zeros((self.nrows[name],) + self.shape[name],
+                                 self.dtype[name])
+        return out
+
+    def avals(self) -> dict[str, Any]:
+        """Abstract shapes/dtypes of :meth:`materialize`'s output — what
+        AOT cache warming traces against so compiles happen WITHOUT
+        materializing (or moving) a single tile."""
+        import jax
+        out = {}
+        for name, dc in self.dcs.items():
+            if not self.rows[name]:
+                continue
+            if self.layout[name] == "dense":
+                out[name] = jax.ShapeDtypeStruct(
+                    (dc.lm, dc.ln), np.dtype(dc.dtype))
+            else:
+                out[name] = jax.ShapeDtypeStruct(
+                    (self.nrows[name],) + self.shape[name],
+                    self.dtype[name])
+        for name in self.scratch:
+            out[name] = jax.ShapeDtypeStruct(
+                (self.nrows[name],) + self.shape[name], self.dtype[name])
         return out
 
     def writeback(self, values: dict[str, Any]) -> None:
@@ -647,27 +802,35 @@ def _try_chain_collapse(tp, infos, stores: _Stores):
 # pass 2: wavefront batching (one vmapped kernel call per (level, class))
 # ---------------------------------------------------------------------------
 
-def _build_wavefront(tp, infos, stores: _Stores):
-    """Group independent same-class tasks per topological wavefront and emit
-    ONE batched kernel call per (wavefront, class, source-signature) group.
+class _WFPlan:
+    """The wavefront resolution of one taskpool: per-task gather/scatter
+    plans against store rows, hazard-checked — the shared substrate of
+    the whole-pool wavefront emission AND the per-region megakernel
+    emission (which slices these plans into region-local programs)."""
+
+    __slots__ = ("plans", "dirty_by_name", "levels")
+
+    def __init__(self, plans, dirty_by_name, levels) -> None:
+        # plans: [(node, level, cname, key, in_plan, out_plan)]
+        self.plans = plans
+        self.dirty_by_name = dirty_by_name
+        self.levels = levels
+
+
+def _wavefront_plan(tp, infos, stores: _Stores) -> _WFPlan:
+    """Resolve every data-flow value to a store row and hazard-check the
+    in-place row reuse (the shared analysis under the wavefront and
+    region emissions).
 
     The key resolution step: *every data-flow value lives in a store row*.
-    A task's input either names a collection tile directly (``data=``) or a
-    predecessor's flow value — and that value, recursively, is an updated
-    *version* of some tile (tiled dataflow is tile versioning).  Writable
-    flows therefore update their home row **in place** inside the jit-local
-    stores; successors gather from the same rows.  Versions are tracked
-    statically and any interleaving where in-place reuse would clobber a
-    still-needed version raises :class:`LoweringError` (→ unrolled pass).
-
-    Within one wavefront all tasks are independent (levels are longest-path:
-    every dep edge strictly crosses levels), so each level executes as
-    *gather-all → compute groups → scatter-all* — snapshot semantics that
-    make the level's result independent of group ordering.  The emitted
-    program is O(levels·classes) XLA ops; a whole Cholesky trailing update
-    becomes one ``vmap``-batched tile matmul on the MXU (the compiled analog
-    of the reference keeping a GPU stream saturated across a panel,
-    ``jdf2c.c:6566``, ``device_gpu.c:2522-2531``).
+    A task's input either names a collection tile directly (``data=``), a
+    predecessor's flow value — which, recursively, is an updated *version*
+    of some tile (tiled dataflow is tile versioning) — or a NEW arrow,
+    backed by a zero-initialized synthetic scratch store.  Writable flows
+    update their home row **in place**; successors gather from the same
+    rows.  Versions are tracked statically and any interleaving where
+    in-place reuse would clobber a still-needed version raises
+    :class:`LoweringError` (→ unrolled pass / dynamic runtime).
     """
     order, levels = _task_graph(tp, infos)
 
@@ -692,7 +855,9 @@ def _build_wavefront(tp, infos, stores: _Stores):
         tc, loc = info.tc, info.tasks[i]
         key = tc.make_key(loc)
         L = levels[node]
-        in_plan: list[tuple] = []   # ("row", name, row) | ("none",) per flow
+        writable_ids = {id(f) for f in info.writable_flows}
+        # per flow: ("row", name, row) | ("none",) | ("new", shape, dtype)
+        in_plan: list[tuple] = []
         in_vers: list[tuple | None] = []          # version read, per flow
         for f in info.data_flows:
             deps = _active_in_deps(f, loc)
@@ -700,7 +865,7 @@ def _build_wavefront(tp, infos, stores: _Stores):
                 raise LoweringError(
                     f"{cname}{key} flow {f.name}: {len(deps)} active input "
                     f"deps — ambiguous source")
-            if not deps:
+            if not deps or deps[0].null:
                 in_plan.append(("none",))
                 in_vers.append(None)
                 continue
@@ -709,6 +874,25 @@ def _build_wavefront(tp, infos, stores: _Stores):
                 dc, k = d.data_ref(loc)
                 row = (dc.name, stores.row(dc, _norm_key(k)))
                 ver = ("init", L)
+            elif d.target_class is None:
+                # NEW arrow: zeros of the declared type (scratch_copy's
+                # policy).  A writable flow whose value never reaches a
+                # collection still needs a store-resident home row so
+                # successors can gather it — the synthetic scratch store;
+                # otherwise the zeros synthesize inline in the program.
+                dtt = d.dtt or f.dtt
+                shape, dtype = tuple(dtt.shape), np.dtype(dtt.dtype)
+                has_data_out = any(
+                    dd.data_ref is not None
+                    for dd in _active_out_deps(f, loc))
+                if id(f) in writable_ids and not has_data_out:
+                    row = stores.scratch_row(cname, f.name, key,
+                                             shape, dtype)
+                    ver = ("init", L)
+                else:
+                    in_plan.append(("new", shape, str(dtype)))
+                    in_vers.append(None)
+                    continue
             else:
                 ptc = tp.task_class(d.target_class)
                 pkey = ptc.make_key(d.target_params(loc))
@@ -725,7 +909,6 @@ def _build_wavefront(tp, infos, stores: _Stores):
             reads.append((row, ver, L))
             in_plan.append(("row",) + row)
             in_vers.append(ver)
-        writable_ids = {id(f) for f in info.writable_flows}
         out_plan = []               # (primary|None, extras, writable) per flow
         for fj, f in enumerate(info.data_flows):
             drows = []
@@ -762,7 +945,7 @@ def _build_wavefront(tp, infos, stores: _Stores):
                     # pass-through: successors read the same row/version
                     value_of[(cname, key, f.flow_index)] = (
                         ip[1], ip[2], in_vers[fj])
-                elif drows:
+                elif drows and ip[0] != "new":
                     raise LoweringError(
                         f"{cname}{key} flow {f.name}: collection write from "
                         f"a flow with no input value")
@@ -770,7 +953,7 @@ def _build_wavefront(tp, infos, stores: _Stores):
                     writes.setdefault(w, []).append((L, node, False))
                     data_last[w] = max(data_last.get(w, -1), L)
                 out_plan.append((None, drows, False))
-        plans.append((node, L, cname, in_plan, out_plan))
+        plans.append((node, L, cname, key, in_plan, out_plan))
 
     # ---- static hazard checks (violations → unrolled fallback) -------------
     for w, ws in writes.items():
@@ -814,7 +997,10 @@ def _build_wavefront(tp, infos, stores: _Stores):
     for w, sl in scratch_last.items():
         dl = data_last.get(w, -1)
         if dl < 0:
-            dirty.append(w)         # scratch-only row: restore at the end
+            # scratch-only row: restore at the end (synthetic NEW stores
+            # are exempt — their post-run content is never observed)
+            if w[0] not in stores.scratch:
+                dirty.append(w)
         elif sl > dl:
             raise LoweringError(
                 f"store row {w}: in-place write at level {sl} after the "
@@ -823,18 +1009,28 @@ def _build_wavefront(tp, infos, stores: _Stores):
     for name, grp in itertools.groupby(sorted(dirty), key=lambda w: w[0]):
         dirty_by_name[name] = np.array([r for _, r in grp], np.int32)
 
-    # ---- grouping ----------------------------------------------------------
+    return _WFPlan(plans, dirty_by_name, levels)
+
+
+def _group_plans(plans, infos, xlate: Callable | None = None):
+    """Group per-task plans into ONE batched kernel call per (wavefront,
+    class, source-signature) and build the gather/scatter specs.  Returns
+    ``{level: [spec, ...]}``; ``xlate(store, row) -> row`` remaps global
+    store rows (the region emission compacts each region onto local
+    row-slices; identity for the whole-pool program)."""
+    if xlate is None:
+        xlate = lambda name, row: row           # noqa: E731
     by_level: dict[int, dict[tuple, list]] = {}
-    for node, L, cname, in_plan, out_plan in plans:
+    for node, L, cname, key, in_plan, out_plan in plans:
         sig = (cname,
-               tuple(ip[0] if ip[0] == "none" else ("row", ip[1])
+               tuple(ip if ip[0] in ("none", "new") else ("row", ip[1])
                      for ip in in_plan),
                tuple((p[0] if p else None, tuple(n for n, _ in ex), w)
                      for p, ex, w in out_plan))
         by_level.setdefault(L, {}).setdefault(sig, []).append(
             (in_plan, out_plan))
 
-    level_specs = []
+    level_specs: dict[int, list] = {}
     for L in sorted(by_level):
         specs = []
         for sig, members in by_level[L].items():
@@ -843,20 +1039,26 @@ def _build_wavefront(tp, infos, stores: _Stores):
             G = len(members)
             # per data flow: None | (name, kind, arg) with kind "const"
             # (one row feeds the whole group), "range" (contiguous rows:
-            # a static slice, cheaper than a gather), or "gather"
+            # a static slice, cheaper than a gather), "gather", or "new"
+            # (zeros of a static shape synthesized inline)
             gathers = []
             for fj in range(len(info.data_flows)):
                 ip0 = members[0][0][fj]
                 if ip0[0] == "none":
                     gathers.append(None)
                     continue
-                rows = np.array([m[0][fj][2] for m in members], np.int32)
+                if ip0[0] == "new":
+                    gathers.append(("", "new", (ip0[1], ip0[2])))
+                    continue
+                name = ip0[1]
+                rows = np.array([xlate(name, m[0][fj][2])
+                                 for m in members], np.int32)
                 if (rows == rows[0]).all():
-                    gathers.append((ip0[1], "const", int(rows[0])))
+                    gathers.append((name, "const", int(rows[0])))
                 elif (np.diff(rows) == 1).all():
-                    gathers.append((ip0[1], "range", int(rows[0])))
+                    gathers.append((name, "range", int(rows[0])))
                 else:
-                    gathers.append((ip0[1], "gather", rows))
+                    gathers.append((name, "gather", rows))
             wi = {f.flow_index: j for j, f in enumerate(info.writable_flows)}
             scatters = []   # (name, rows array, src_kind, src_idx)
             for fj, f in enumerate(info.data_flows):
@@ -864,121 +1066,173 @@ def _build_wavefront(tp, infos, stores: _Stores):
                 if writable:
                     n_tgt = 1 + len(members[0][1][fj][1])
                     for t in range(n_tgt):
-                        rows = np.array(
-                            [(m[1][fj][0] if t == 0 else m[1][fj][1][t - 1])[1]
-                             for m in members], np.int32)
                         name = (members[0][1][fj][0] if t == 0
                                 else members[0][1][fj][1][t - 1])[0]
+                        rows = np.array(
+                            [xlate(name,
+                                   (m[1][fj][0] if t == 0
+                                    else m[1][fj][1][t - 1])[1])
+                             for m in members], np.int32)
                         scatters.append((name, rows, "out", wi[f.flow_index]))
                 else:
                     for t in range(len(members[0][1][fj][1])):
-                        rows = np.array([m[1][fj][1][t][1] for m in members],
-                                        np.int32)
                         name = members[0][1][fj][1][t][0]
+                        rows = np.array(
+                            [xlate(name, m[1][fj][1][t][1])
+                             for m in members], np.int32)
                         scatters.append((name, rows, "in", fj))
             specs.append((info.kernel.apply, gathers, scatters, G))
-        level_specs.append(specs)
+        level_specs[L] = specs
+    return level_specs
+
+
+def _build_wavefront(tp, infos, stores: _Stores):
+    """The whole-pool wavefront emission: one program over the full task
+    DAG, O(levels·classes) XLA ops.  Within one wavefront all tasks are
+    independent (levels are longest-path: every dep edge strictly crosses
+    levels), so each level executes as *gather-all → compute groups →
+    scatter-all* — snapshot semantics that make the level's result
+    independent of group ordering.  A whole Cholesky trailing update
+    becomes one ``vmap``-batched tile matmul on the MXU (the compiled
+    analog of the reference keeping a GPU stream saturated across a
+    panel, ``jdf2c.c:6566``, ``device_gpu.c:2522-2531``).
+    """
+    wf = _wavefront_plan(tp, infos, stores)
+    level_specs = _group_plans(wf.plans, infos)
+    dirty_by_name = wf.dirty_by_name
 
     # ---- emission ----------------------------------------------------------
-    def _apply_scatters(arr, entries):
-        """Apply one level's scatters to one store as a SINGLE update.
-        Separate ``.at[].set`` calls each copy the whole store; merging
-        them (and lowering contiguous row sets to a static slice update —
-        full-coverage levels like a stencil sweep become a plain slab
-        assignment) keeps the per-level cost at the data actually moved."""
-        import jax.numpy as jnp
-        rows_all = np.concatenate([rows for rows, _, _ in entries])
-        vals = []
-        for rows, v, batched in entries:
-            vals.append(v if batched
-                        else jnp.broadcast_to(v, (len(rows),) + v.shape))
-        v_all = vals[0] if len(vals) == 1 else jnp.concatenate(vals, axis=0)
-        order = np.argsort(rows_all, kind="stable")
-        srt = rows_all[order]
-        if (np.diff(srt) == 1).all():
-            if not (order == np.arange(len(order))).all():
-                v_all = v_all[order]
-            r0 = int(srt[0])
-            return arr.at[r0:r0 + len(srt)].set(v_all)
-        return arr.at[rows_all].set(v_all)
+    runs = _fold_runs(level_specs)
+    scan_min = _params.get("lowering_scan_min")
+    step_fn = _make_step(runs, dirty_by_name, scan_min)
+    sig = ("wavefront", scan_min, _freeze(dirty_by_name), _freeze_runs(runs))
+    return step_fn, sig
 
-    def _run_level(st: dict, specs) -> dict:
-        import jax
-        st = dict(st)
-        pend: dict[str, list] = {}           # scatters applied level-atomic
-        for apply, gathers, scatters, G in specs:
-            args, axes = [], []
-            for gth in gathers:
-                if gth is None:
-                    args.append(None)
-                    axes.append(None)
-                elif gth[1] == "const":
-                    args.append(st[gth[0]][gth[2]])
-                    axes.append(None)
-                elif gth[1] == "range":
-                    args.append(st[gth[0]][gth[2]:gth[2] + G])
-                    axes.append(0)
-                else:
-                    args.append(st[gth[0]][gth[2]])
-                    axes.append(0)
-            if G == 1 or all(ax is None for ax in axes):
-                res = apply(*args)
-                res = res if isinstance(res, tuple) else (res,)
-                out_batched = False
+
+def _apply_scatters(arr, entries):
+    """Apply one level's scatters to one store as a SINGLE update.
+    Separate ``.at[].set`` calls each copy the whole store; merging
+    them (and lowering contiguous row sets to a static slice update —
+    full-coverage levels like a stencil sweep become a plain slab
+    assignment) keeps the per-level cost at the data actually moved."""
+    import jax.numpy as jnp
+    rows_all = np.concatenate([rows for rows, _, _ in entries])
+    vals = []
+    for rows, v, batched in entries:
+        vals.append(v if batched
+                    else jnp.broadcast_to(v, (len(rows),) + v.shape))
+    v_all = vals[0] if len(vals) == 1 else jnp.concatenate(vals, axis=0)
+    order = np.argsort(rows_all, kind="stable")
+    srt = rows_all[order]
+    if (np.diff(srt) == 1).all():
+        if not (order == np.arange(len(order))).all():
+            v_all = v_all[order]
+        r0 = int(srt[0])
+        return arr.at[r0:r0 + len(srt)].set(v_all)
+    return arr.at[rows_all].set(v_all)
+
+
+def _run_level(st: dict, specs) -> dict:
+    import jax
+    import jax.numpy as jnp
+    st = dict(st)
+    pend: dict[str, list] = {}           # scatters applied level-atomic
+    for apply, gathers, scatters, G in specs:
+        args, axes = [], []
+        for gth in gathers:
+            if gth is None:
+                args.append(None)
+                axes.append(None)
+            elif gth[1] == "const":
+                args.append(st[gth[0]][gth[2]])
+                axes.append(None)
+            elif gth[1] == "range":
+                args.append(st[gth[0]][gth[2]:gth[2] + G])
+                axes.append(0)
+            elif gth[1] == "new":
+                shape, dtype = gth[2]
+                args.append(jnp.zeros(shape, dtype))
+                axes.append(None)
             else:
-                def tup_apply(*a):
-                    r = apply(*a)
-                    return r if isinstance(r, tuple) else (r,)
-                res = jax.vmap(tup_apply, in_axes=tuple(axes))(*args)
-                out_batched = True
-            for name, rows, src_kind, src_idx in scatters:
-                if src_kind == "out":
-                    v, batched = res[src_idx], out_batched
-                else:
-                    v, batched = args[src_idx], axes[src_idx] == 0
-                if not batched and len(rows) == 1 and v is not None:
-                    v = v[None]
-                    batched = True
-                pend.setdefault(name, []).append((rows, v, batched))
-        for name, entries in pend.items():
-            st[name] = _apply_scatters(st[name], entries)
-        return st
+                args.append(st[gth[0]][gth[2]])
+                axes.append(0)
+        if G == 1 or all(ax is None for ax in axes):
+            res = apply(*args)
+            res = res if isinstance(res, tuple) else (res,)
+            out_batched = False
+        else:
+            def tup_apply(*a):
+                r = apply(*a)
+                return r if isinstance(r, tuple) else (r,)
+            res = jax.vmap(tup_apply, in_axes=tuple(axes))(*args)
+            out_batched = True
+        for name, rows, src_kind, src_idx in scatters:
+            if src_kind == "out":
+                v, batched = res[src_idx], out_batched
+            else:
+                v, batched = args[src_idx], axes[src_idx] == 0
+            if not batched and len(rows) == 1 and v is not None:
+                v = v[None]
+                batched = True
+            pend.setdefault(name, []).append((rows, v, batched))
+    for name, entries in pend.items():
+        st[name] = _apply_scatters(st[name], entries)
+    return st
 
-    # ---- uniform-run folding (compile-cost control) ------------------------
-    # Consecutive levels with FULLY IDENTICAL specs — same kernels, same
-    # group sizes, same gather/scatter kinds AND row indices (a stencil
-    # sweep's T iterations; never a shrinking factorization panel) —
-    # become ONE lax.scan body: identical per-iteration ops, O(1) trace/
-    # compile cost instead of O(levels).  VERDICT r4 weak #2 named the
-    # O(wavefronts x classes) op count as the likely next compile wall.
-    def _spec_eq(a, b) -> bool:
-        if len(a) != len(b):
+
+# ---- uniform-run folding (compile-cost control) ---------------------------
+# Consecutive levels with FULLY IDENTICAL specs — same kernels, same
+# group sizes, same gather/scatter kinds AND row indices (a stencil
+# sweep's T iterations; never a shrinking factorization panel) —
+# become ONE lax.scan body: identical per-iteration ops, O(1) trace/
+# compile cost instead of O(levels).  VERDICT r4 weak #2 named the
+# O(wavefronts x classes) op count as the likely next compile wall.
+def _spec_eq(a, b) -> bool:
+    if len(a) != len(b):
+        return False
+    for (ap, ag, as_, aG), (bp, bg, bs, bG) in zip(a, b):
+        if ap is not bp or aG != bG or len(ag) != len(bg) \
+                or len(as_) != len(bs):
             return False
-        for (ap, ag, as_, aG), (bp, bg, bs, bG) in zip(a, b):
-            if ap is not bp or aG != bG or len(ag) != len(bg) \
-                    or len(as_) != len(bs):
+        for x, y in zip(ag, bg):
+            if (x is None) != (y is None):
                 return False
-            for x, y in zip(ag, bg):
-                if (x is None) != (y is None):
+            if x is None:
+                continue
+            if x[0] != y[0] or x[1] != y[1]:
+                return False
+            if x[1] == "new":
+                if x[2] != y[2]:
                     return False
-                if x is not None and (
-                        x[0] != y[0] or x[1] != y[1]
-                        or not np.array_equal(x[2], y[2])):
-                    return False
-            for x, y in zip(as_, bs):
-                if x[0] != y[0] or x[2] != y[2] or x[3] != y[3] \
-                        or not np.array_equal(x[1], y[1]):
-                    return False
-        return True
+            elif not np.array_equal(x[2], y[2]):
+                return False
+        for x, y in zip(as_, bs):
+            if x[0] != y[0] or x[2] != y[2] or x[3] != y[3] \
+                    or not np.array_equal(x[1], y[1]):
+                return False
+    return True
 
+
+def _fold_runs(level_specs: dict[int, list]) -> list[tuple[Any, int]]:
     runs: list[tuple[Any, int]] = []        # (specs, repeat count)
-    for specs in level_specs:
+    for L in sorted(level_specs):
+        specs = level_specs[L]
         if runs and _spec_eq(runs[-1][0], specs):
             runs[-1] = (runs[-1][0], runs[-1][1] + 1)
         else:
             runs.append((specs, 1))
-    scan_min = _params.get("lowering_scan_min")
+    return runs
 
+
+def _freeze_runs(runs) -> tuple:
+    return tuple(
+        (reps, tuple((apply, _freeze(gathers), _freeze(scatters), G)
+                     for apply, gathers, scatters, G in specs))
+        for specs, reps in runs)
+
+
+def _make_step(runs, dirty_by_name: dict[str, np.ndarray],
+               scan_min: int) -> Callable:
     def step_fn(st: dict) -> dict:
         import jax
         st = dict(st)
@@ -996,11 +1250,7 @@ def _build_wavefront(tp, infos, stores: _Stores):
             st[name] = st[name].at[rows].set(saved[name])
         return st
 
-    sig = ("wavefront", scan_min, _freeze(dirty_by_name), tuple(
-        (reps, tuple((apply, _freeze(gathers), _freeze(scatters), G)
-                     for apply, gathers, scatters, G in specs))
-        for specs, reps in runs))
-    return step_fn, sig
+    return step_fn
 
 
 # ---------------------------------------------------------------------------
@@ -1067,17 +1317,26 @@ def _build_unrolled(tp, infos, stores: _Stores):
         info = infos[cname]
         tc, loc = info.tc, info.tasks[i]
         key = tc.make_key(loc)
-        in_plan = []        # per data flow: ("store", name, row) | ("val", ck)
+        # per data flow: ("store", name, row) | ("val", ck) | ("none",)
+        # | ("new", shape, dtype)
+        in_plan = []
         for f in info.data_flows:
             deps = _active_in_deps(f, loc)
-            if len(deps) != 1:
+            if len(deps) > 1:
                 raise LoweringError(
-                    f"{cname}{key} flow {f.name}: expected exactly one "
+                    f"{cname}{key} flow {f.name}: expected at most one "
                     f"active input dep, got {len(deps)}")
+            if not deps or deps[0].null:
+                in_plan.append(("none",))
+                continue
             d = deps[0]
             if d.data_ref is not None:
                 dc, k = d.data_ref(loc)
                 in_plan.append(("store", dc.name, stores.row(dc, _norm_key(k))))
+            elif d.target_class is None:
+                dtt = d.dtt or f.dtt
+                in_plan.append(("new", tuple(dtt.shape),
+                                str(np.dtype(dtt.dtype))))
             else:
                 ptc = tp.task_class(d.target_class)
                 pkey = ptc.make_key(d.target_params(loc))
@@ -1096,6 +1355,7 @@ def _build_unrolled(tp, infos, stores: _Stores):
         plans.append((cname, key, info, in_plan, out_plan))
 
     def step_fn(st: dict) -> dict:
+        import jax.numpy as jnp
         st = dict(st)
         vals: dict[tuple, Any] = {}
         for cname, key, info, in_plan, out_plan in plans:
@@ -1104,6 +1364,10 @@ def _build_unrolled(tp, infos, stores: _Stores):
                 if kind == "store":
                     name, row = ref
                     args.append(st[name][row])
+                elif kind == "none":
+                    args.append(None)
+                elif kind == "new":
+                    args.append(jnp.zeros(ref[0], ref[1]))
                 else:
                     args.append(vals[ref[0]])
             if info.kernel is not None and args:
@@ -1184,8 +1448,11 @@ class LoweredTaskpool:
         key = None
         if self.signature is not None and _params.get("lowering_cache"):
             # the mesh object hashes by devices+axes: a same-shape mesh on
-            # different devices can never false-hit
-            key = (self.mode, self.mesh,
+            # different devices can never false-hit; the backend triple
+            # (jax version, backend, device kind) keeps a cache consulted
+            # across a JAX_PLATFORMS flip — or a compile-cache dir shared
+            # by CPU and TPU processes — from serving a stale executable
+            key = (self.mode, self.mesh, _backend_signature(),
                    tuple(sorted(self._stores.replicated)), self.signature)
         self._jitted = lowering_cache.get_or_build(key, build)
         return self._jitted
@@ -1209,6 +1476,24 @@ class LoweredTaskpool:
             out[name] = NamedSharding(self.mesh, spec)
         return out
 
+    def warm(self) -> dict[str, float]:
+        """AOT trace + compile against abstract avals — no tile is
+        materialized or moved and nothing executes.  Populates JAX's
+        persistent compilation cache (and warms this process's jit
+        wrapper tracing path), so a later bench stage or a fresh process
+        pays deserialization, not a full XLA compile (the BENCH_r04/r05
+        rc-124 shape).  The cache-warming CLI (``python -m
+        parsec_tpu.ptg.lowering --warm``) drives this."""
+        _ensure_persistent_compile_cache()
+        jf = self.jitted()
+        avals = self._stores.avals()
+        t0 = time.perf_counter()
+        lowered = jf.lower(avals)
+        t1 = time.perf_counter()
+        lowered.compile()
+        return {"trace_s": round(t1 - t0, 4),
+                "compile_s": round(time.perf_counter() - t1, 4)}
+
     def execute(self) -> dict[str, Any]:
         from ..prof.profiling import profiling
         self.jitted()
@@ -1222,6 +1507,7 @@ class LoweredTaskpool:
                             info={"taskpool": self.taskpool.name,
                                   "mode": self.mode})
         out = self._jitted(self.initial_stores())
+        _note_xla_calls(1)          # one program, one dispatch
         self._stores.writeback(out)
         if keys is not None:
             profiling.trace(keys[1], object_id=id(self))
@@ -1282,3 +1568,616 @@ def lower_taskpool(tp, context: Any = None, mesh: Any = None,
     step, sig = _build_unrolled(tp, infos, stores)
     return LoweredTaskpool(tp, step, stores, "unrolled", mesh=mesh,
                            signature=sig)
+
+
+# ---------------------------------------------------------------------------
+# megakernel regions (MPK): one jitted program per verified subgraph,
+# runtime scheduling only at region boundaries, under a compile budget
+# ---------------------------------------------------------------------------
+
+class LoweredRegion:
+    """One convex subregion of a taskpool, lowered to one program.
+
+    The program is a pure function over *region-local row slices*: the
+    runtime boundary gathers the rows the region touches from the shared
+    host table, calls the compiled executable (or, for budget-shed
+    regions, the same step function eagerly, op by op), and scatters the
+    written rows back — deps, comm, and device staging live entirely at
+    this boundary, exactly the MPK contract."""
+
+    __slots__ = ("index", "ntasks", "level_lo", "level_hi", "step_fn",
+                 "signature", "touched", "written", "avals", "preds",
+                 "succs", "eager", "compiled", "compile_s", "trace_s",
+                 "_exec")
+
+    def __init__(self, index: int, ntasks: int, level_lo: int,
+                 level_hi: int, step_fn: Callable | None, signature: Any,
+                 touched: dict[str, np.ndarray],
+                 written: dict[str, tuple[np.ndarray, np.ndarray]],
+                 avals: dict[str, Any]) -> None:
+        self.index = index
+        self.ntasks = ntasks
+        self.level_lo = level_lo
+        self.level_hi = level_hi
+        self.step_fn = step_fn          # None: CTL-only region (no data)
+        self.signature = signature
+        self.touched = touched          # store -> global rows gathered
+        self.written = written          # store -> (global rows, local rows)
+        self.avals = avals
+        self.preds: set[int] = set()    # region deps (task + row-conflict)
+        self.succs: set[int] = set()
+        self.eager = False              # budget-shed: run uncompiled
+        self.compiled = False
+        self.compile_s = 0.0
+        self.trace_s = 0.0
+        self._exec = None
+
+    def __repr__(self) -> str:
+        state = ("compiled" if self.compiled
+                 else "eager" if self.eager else "cold")
+        return (f"<LoweredRegion {self.index}: {self.ntasks} tasks, "
+                f"levels {self.level_lo}..{self.level_hi}, {state}>")
+
+
+class RegionLoweredTaskpool:
+    """A taskpool lowered to a DAG of megakernel regions.
+
+    ``compile(budget_s=)`` stages compilation region by region (smallest
+    first, so measured cost guards the big compiles) under the
+    wall-clock budget — regions the budget cannot afford fall back to
+    the eager path, so a compile can never eat a bench stage's deadline
+    (BENCH_r04/r05, rc 124).  ``taskpool()``
+    builds a PTG pool with ONE task per region (ranged CTL fan-in edges
+    mirroring the region DAG) — the runtime schedules regions exactly
+    like tasks: deps, priorities, worker concurrency, flight recorder.
+    ``execute()`` is the convenience wrapper: materialize the shared
+    row table, run the region pool on a Context, write tiles back."""
+
+    def __init__(self, tp, stores: _Stores, regions: list[LoweredRegion],
+                 dirty_by_name: dict[str, np.ndarray]) -> None:
+        self.source = tp            # the task-grained pool this lowers
+        self.mode = "region"
+        self._stores = stores
+        self.regions = regions
+        self.dirty_by_name = dirty_by_name
+        self._lock = threading.Lock()
+        self._compile_done = False
+        self._dirty_saved: dict[str, np.ndarray] = {}
+        self._finalized = True
+        self.xla_calls = 0          # compiled-program invocations (lifetime)
+        self.eager_runs = 0
+
+    # -- compile budget ------------------------------------------------------
+    def _cache_key(self, reg: LoweredRegion):
+        if not _params.get("lowering_cache"):
+            return None
+        shapes = tuple(sorted((nm, tuple(a.shape), str(a.dtype))
+                              for nm, a in reg.avals.items()))
+        return ("region", _backend_signature(), shapes, reg.signature)
+
+    def compile(self, budget_s: float | None = None,
+                note: Callable | None = None) -> dict:
+        """Staged AOT compilation, SMALLEST region first.
+
+        ``budget_s`` defaults to the ``lowering_compile_budget_s`` MCA
+        param (0 = unbudgeted).  The budget is enforced *between*
+        compiles: before each region the spent wall clock plus a
+        per-task cost estimate (measured from the regions already
+        compiled) must fit, else the region is shed to the eager path.
+        Ascending size order is what makes the estimate load-bearing —
+        the cheap compiles bootstrap the rate that guards the expensive
+        ones, so the largest region is shed BEFORE burning the budget,
+        never after (largest-first would run the most dangerous compile
+        while the rate is still 0).  An XLA compile cannot be aborted
+        mid-flight, so the one unguarded compile is the smallest region;
+        ``lowering_region_max_tasks`` is what bounds the worst single
+        compile.  Cache hits are free and never shed — a warm process
+        compiles nothing.  ``note(**kw)`` receives one progress record
+        per region (the bench harness forwards these to ``_note_partial``
+        so a deadline death names which region was compiling)."""
+        import jax
+        _ensure_persistent_compile_cache()
+        if budget_s is None:
+            b = _params.get("lowering_compile_budget_s")
+            budget_s = float(b) if b and b > 0 else None
+        t_start = time.perf_counter()
+        rate = 0.0                  # measured compile seconds per task
+        for reg in sorted(self.regions, key=lambda r: r.ntasks):
+            if reg.step_fn is None or reg.compiled or reg._exec is not None:
+                continue
+            key = self._cache_key(reg)
+            cached = lowering_cache.peek(key)
+            if cached is not None:
+                # a warm region re-registers as a hit; *_compile_s ~ 0
+                reg._exec = lowering_cache.get_or_build(key, lambda: cached)
+                reg.compiled, reg.eager = True, False
+                reg.compile_s = reg.trace_s = 0.0
+                if note is not None:
+                    note(region=reg.index, ntasks=reg.ntasks,
+                         compile_s=0.0, cached=True)
+                continue
+            if budget_s is not None:
+                remaining = budget_s - (time.perf_counter() - t_start)
+                if remaining <= 0 or rate * reg.ntasks > remaining:
+                    reg.eager = True
+                    if note is not None:
+                        note(region=reg.index, ntasks=reg.ntasks,
+                             eager=True, budget_s=budget_s)
+                    continue
+            if note is not None:
+                note(region=reg.index, ntasks=reg.ntasks, compiling=True)
+
+            def build(reg=reg):
+                jf = jax.jit(reg.step_fn)
+                t0 = time.perf_counter()
+                lowered = jf.lower(reg.avals)
+                reg.trace_s = time.perf_counter() - t0
+                t1 = time.perf_counter()
+                compiled = lowered.compile()
+                reg.compile_s = time.perf_counter() - t1
+                return compiled
+
+            reg._exec = lowering_cache.get_or_build(key, build)
+            reg.compiled, reg.eager = True, False
+            if reg.ntasks:
+                rate = max(rate, (reg.compile_s + reg.trace_s) / reg.ntasks)
+            if note is not None:
+                note(region=reg.index, ntasks=reg.ntasks,
+                     compile_s=round(reg.compile_s, 4),
+                     trace_s=round(reg.trace_s, 4))
+        self._compile_done = True
+        return self.stats()
+
+    def stats(self) -> dict:
+        data_regions = [r for r in self.regions if r.step_fn is not None]
+        return {
+            "regions": len(self.regions),
+            "regions_compiled": sum(r.compiled for r in data_regions),
+            "regions_eager": sum(r.eager for r in data_regions),
+            "ntasks": sum(r.ntasks for r in self.regions),
+            "trace_s": round(sum(r.trace_s for r in data_regions), 4),
+            "compile_s": round(sum(r.compile_s for r in data_regions), 4),
+            "xla_calls": self.xla_calls,
+            "eager_runs": self.eager_runs,
+        }
+
+    # -- execution -----------------------------------------------------------
+    def materialize_table(self) -> dict[str, np.ndarray]:
+        """The shared host row table regions gather from / scatter into.
+        Mutable numpy (regions write disjoint rows, ordered by the region
+        DAG); dirty rows — in-place value homes the source program never
+        writes back — are snapshotted for restore at finalize."""
+        table = {nm: np.array(v)
+                 for nm, v in self._stores.materialize().items()}
+        self._dirty_saved = {nm: table[nm][rows].copy()
+                             for nm, rows in self.dirty_by_name.items()}
+        self._finalized = False
+        return table
+
+    def run_region(self, r: int, table: dict[str, np.ndarray]) -> None:
+        """Execute region ``r`` against the shared table: gather touched
+        rows, run the compiled program (ONE XLA dispatch) or the eager
+        step, scatter written rows back.  This is the region task's body
+        — what a worker thread runs when the scheduler releases it."""
+        reg = self.regions[r]
+        if reg.step_fn is None:
+            return
+        inputs = {nm: table[nm][rows] for nm, rows in reg.touched.items()}
+        if reg._exec is not None:
+            out = reg._exec(inputs)
+            with self._lock:
+                self.xla_calls += 1
+            _note_xla_calls(1)
+        else:
+            import jax.numpy as jnp
+            out = reg.step_fn({nm: jnp.asarray(v)
+                               for nm, v in inputs.items()})
+            with self._lock:
+                self.eager_runs += 1
+        for nm, (grows, lrows) in reg.written.items():
+            table[nm][grows] = np.asarray(out[nm])[lrows]
+
+    def taskpool(self, table: dict[str, np.ndarray]):
+        """Build the schedulable region pool: one REGION(r) task per
+        region, the region DAG as ranged CTL fan-in/fan-out edges — a
+        plain PTG pool, so graphcheck verifies it and the runtime
+        (Context, RuntimeServer) schedules it like any other.  Completion
+        finalizes the table back into the source collections."""
+        from . import dsl
+        preds = tuple(tuple(sorted(r.preds)) for r in self.regions)
+        succs = tuple(tuple(sorted(r.succs)) for r in self.regions)
+        p = dsl.PTGBuilder(f"{self.source.name}_regions",
+                           NR=len(self.regions), RPRED=preds, RSUCC=succs)
+        t = p.task("REGION", r=dsl.span(0, lambda g, l: g.NR - 1))
+        f = t.flow("ctl", dsl.CTL)
+        f.input(pred=("REGION", "ctl",
+                      lambda g, l: [{"r": q} for q in g.RPRED[l.r]]),
+                guard=lambda g, l: bool(g.RPRED[l.r]), ranged=True)
+        f.output(succ=("REGION", "ctl",
+                       lambda g, l: [{"r": q} for q in g.RSUCC[l.r]]),
+                 guard=lambda g, l: bool(g.RSUCC[l.r]))
+        # earlier wavefront bands first: the region-grain critical path
+        t.priority(lambda g, l: -self.regions[l.r].level_lo)
+        plan = self
+
+        def body(es: Any, task: Any, g: Any, l: Any) -> None:
+            plan.run_region(l.r, table)
+
+        t.body(body)
+        pool = p.build()
+        pool.region_plan = self
+        pool.add_completion_listener(lambda _tp: self.finalize(table))
+        return pool
+
+    def finalize(self, table: dict[str, np.ndarray]) -> None:
+        """Restore dirty rows (scratch homes the source program never
+        writes back) and write the table's tiles into the collections
+        with version bumps — the dynamic path's completion semantics.
+        Idempotent: fires from the pool completion listener AND from
+        explicit callers."""
+        with self._lock:
+            if self._finalized:
+                return
+            self._finalized = True
+        for nm, rows in self.dirty_by_name.items():
+            table[nm][rows] = self._dirty_saved[nm]
+        self._stores.writeback(table)
+
+    def execute(self, context: Any = None, timeout: float = 300.0,
+                budget_s: float | None = None) -> dict[str, np.ndarray]:
+        """Compile (under the budget), run the region pool to completion,
+        write back.  With ``context=`` the pool rides a live runtime
+        (worker threads execute independent regions concurrently); bare
+        calls drive an ephemeral single-threaded Context."""
+        if not self._compile_done:
+            self.compile(budget_s=budget_s)
+        table = self.materialize_table()
+        pool = self.taskpool(table)
+        if context is not None:
+            context.add_taskpool(pool)
+            pool.wait(timeout=timeout)
+        else:
+            from ..runtime import Context
+            ctx = Context(nb_cores=0)
+            try:
+                ctx.add_taskpool(pool)
+                ctx.wait(timeout=timeout)
+            finally:
+                ctx.fini()
+        self.finalize(table)        # no-op when the listener already ran
+        return table
+
+
+def _note_xla_calls(n: int) -> None:
+    """Feed the process-wide XLA dispatch ledger (device/device.py) so
+    the region path and the dynamic device path share ONE counter — the
+    XLA-calls-per-DAG bench axis reads it for both."""
+    try:
+        from ..device.device import note_xla_calls
+        note_xla_calls(n)
+    except Exception:
+        pass
+
+
+def _written_rows(out_plan) -> list[tuple[str, int]]:
+    """Every (store, row) a task's out_plan writes — extras plus the
+    writable primary.  ONE home for this extraction: the region
+    anti-dependency ledger and the per-region written-set builder must
+    agree on it, or the region DAG under-orders the writebacks."""
+    rows: list[tuple[str, int]] = []
+    for primary, extras, writable in out_plan:
+        rows.extend(extras)
+        if writable and primary is not None:
+            rows.append(primary)
+    return rows
+
+
+def lower_regions(tp, context: Any = None, max_tasks: int | None = None,
+                  report: Any = None) -> RegionLoweredTaskpool:
+    """Lower an irregular PTG taskpool to a DAG of megakernel regions.
+
+    Region selection is driven by graphcheck's *verified* execution
+    space: the pool is statically checked (``analysis.check_ptg``) and
+    its concrete task graph carved into convex wavefront-level bands per
+    weakly-connected component (``analysis.regions``), at most
+    ``max_tasks`` tasks each (default: the ``lowering_region_max_tasks``
+    MCA param).  Each region lowers to one jitted program over its local
+    store-row slices via the same grouped-vmapped wavefront emission as
+    the whole-pool pass — program size stays O(wavefronts·classes), not
+    O(tasks).  Cross-region dataflow resolves through the shared row
+    table; row-level conflicts that task edges alone would not order
+    (cross-component collection reads/writes) become extra region-DAG
+    edges, so region scheduling can never hide a WAR/WAW hazard the
+    whole-pool pass proves ordered.
+
+    Raises :class:`LoweringError` (or ``analysis.GraphCheckError``) when
+    the pool cannot be region-lowered; callers fall back to
+    :func:`lower_taskpool` or the dynamic runtime.
+    """
+    if context is not None and getattr(context, "nb_ranks", 1) > 1:
+        raise LoweringError("region lowering is single-rank; use "
+                            "lower_taskpool(mesh=...) for SPMD lowering")
+    from ..analysis import check_ptg
+    if report is None:
+        report = check_ptg(tp)
+    if max_tasks is None:
+        max_tasks = _params.get("lowering_region_max_tasks")
+    try:
+        regs = report.select_regions(max_tasks=max_tasks)
+    except ValueError as e:
+        # a truncated enumeration (analysis_max_tasks) cannot produce
+        # sound regions — surface it under this function's documented
+        # exception contract so callers' fallback paths engage
+        raise LoweringError(str(e))
+
+    infos = _analyze(tp)
+    stores = _Stores()
+    wf = _wavefront_plan(tp, infos, stores)
+    scan_min = _params.get("lowering_scan_min")
+
+    assign: dict[tuple, int] = {}
+    for r in regs:
+        for node in r.members:
+            assign[node] = r.index
+
+    plans_by_region: list[list] = [[] for _ in regs]
+    # row-access ledger for conflict ordering: row -> [(region, level, w)]
+    accesses: dict[tuple, list[tuple[int, int, bool]]] = {}
+    for plan in wf.plans:
+        node, L, cname, key, in_plan, out_plan = plan
+        try:
+            ri = assign[(cname, key)]
+        except KeyError:
+            raise LoweringError(
+                f"{cname}{key}: enumerated by the lowering but absent "
+                f"from graphcheck's execution space")
+        plans_by_region[ri].append(plan)
+        for ip in in_plan:
+            if ip[0] == "row":
+                accesses.setdefault((ip[1], ip[2]), []).append(
+                    (ri, L, False))
+        for w in _written_rows(out_plan):
+            accesses.setdefault(w, []).append((ri, L, True))
+
+    # ---- region DAG: task edges + row-conflict ordering edges --------------
+    preds = [set(r.preds) for r in regs]
+    succs = [set(r.succs) for r in regs]
+
+    def add_edge(a: int, b: int) -> None:
+        if a != b:
+            succs[a].add(b)
+            preds[b].add(a)
+
+    for row, acc in accesses.items():
+        writes = [(ri, L) for ri, L, w in acc if w]
+        if not writes:
+            continue
+        for wri, wl in writes:
+            for ri, L, w in acc:
+                if ri == wri:
+                    continue
+                if L > wl:
+                    add_edge(wri, ri)       # write before later access
+                elif L < wl:
+                    add_edge(ri, wri)       # earlier access before write
+                elif not w:
+                    # same wavefront, different regions: snapshot
+                    # semantics say the reader sees the PRE-level value,
+                    # so the reader must run first (anti-dependency)
+                    add_edge(ri, wri)
+    # acyclicity of the combined region DAG (task edges alone are acyclic
+    # by construction; anti-dependency edges can, in principle, close a
+    # cycle — then region granularity cannot honor snapshot semantics)
+    indeg = [len(p) for p in preds]
+    ready = [i for i, n in enumerate(indeg) if n == 0]
+    seen = 0
+    while ready:
+        i = ready.pop()
+        seen += 1
+        for s in succs[i]:
+            indeg[s] -= 1
+            if indeg[s] == 0:
+                ready.append(s)
+    if seen != len(regs):
+        raise LoweringError(
+            "region ordering cycle: row-conflict anti-dependencies are "
+            "not satisfiable at region granularity (dynamic path)")
+
+    # ---- per-region emission: local row slices, grouped vmapped levels -----
+    regions: list[LoweredRegion] = []
+    for r, rplans in zip(regs, plans_by_region):
+        if not rplans:                      # CTL-only region: ordering only
+            regions.append(LoweredRegion(
+                r.index, r.ntasks, r.level_lo, r.level_hi,
+                None, None, {}, {}, {}))
+            continue
+        touched_sets: dict[str, set[int]] = {}
+        written_sets: dict[str, set[int]] = {}
+        for node, L, cname, key, in_plan, out_plan in rplans:
+            for ip in in_plan:
+                if ip[0] == "row":
+                    touched_sets.setdefault(ip[1], set()).add(ip[2])
+            for nm, row in _written_rows(out_plan):
+                touched_sets.setdefault(nm, set()).add(row)
+                written_sets.setdefault(nm, set()).add(row)
+        touched = {nm: np.array(sorted(rs), np.int64)
+                   for nm, rs in sorted(touched_sets.items())}
+        lmap = {nm: {g: i for i, g in enumerate(arr.tolist())}
+                for nm, arr in touched.items()}
+        level_specs = _group_plans(
+            rplans, infos, xlate=lambda nm, g: lmap[nm][g])
+        runs = _fold_runs(level_specs)
+        step_fn = _make_step(runs, {}, scan_min)
+        written = {}
+        for nm, rs in sorted(written_sets.items()):
+            grows = np.array(sorted(rs), np.int64)
+            written[nm] = (grows,
+                           np.array([lmap[nm][g] for g in grows.tolist()],
+                                    np.int64))
+        import jax
+        avals = {nm: jax.ShapeDtypeStruct(
+            (len(arr),) + stores.shape[nm], stores.dtype[nm])
+            for nm, arr in touched.items()}
+        # the signature covers ONLY what the traced program depends on:
+        # the grouped runs (gather/scatter specs in region-LOCAL rows)
+        # — the avals join it in the cache key.  Global touched rows are
+        # boundary bookkeeping; folding them in would give structurally
+        # identical regions (the LLM step's N parallel per-seq chains)
+        # N distinct keys and N redundant compiles of one program.
+        sig = ("region", scan_min, _freeze_runs(runs))
+        regions.append(LoweredRegion(
+            r.index, r.ntasks, r.level_lo, r.level_hi,
+            step_fn, sig, touched, written, avals))
+    for reg, p_, s_ in zip(regions, preds, succs):
+        reg.preds, reg.succs = p_, s_
+    return RegionLoweredTaskpool(tp, stores, regions, wf.dirty_by_name)
+
+
+# ---------------------------------------------------------------------------
+# AOT cache warming: pay compiles BEFORE a bench stage's clock starts
+# ---------------------------------------------------------------------------
+
+def _warm_workload(workload: str, n: int | None, nb: int | None):
+    """Build one named workload's taskpool at the given geometry with
+    ZERO-initialized tiles — warming traces against avals, so contents
+    never matter and no bench-scale host RNG runs."""
+    def zeros(*_a):
+        def init(m, n_, shape):
+            return np.zeros(shape, np.float32)
+        return init
+
+    if workload == "gemm":
+        from ..data_dist.matrix import TiledMatrix
+        from ..models.tiled_gemm import tiled_gemm_ptg
+        n, nb = n or 16384, nb or 512
+        import jax.numpy as jnp
+        bf16 = np.dtype(jnp.bfloat16)
+        A = TiledMatrix("A", n, n, nb, nb, dtype=bf16,
+                        init_fn=lambda m, n_, s: np.zeros(s, bf16))
+        B = TiledMatrix("B", n, n, nb, nb, dtype=bf16,
+                        init_fn=lambda m, n_, s: np.zeros(s, bf16))
+        C = TiledMatrix("C", n, n, nb, nb, dtype=np.float32,
+                        init_fn=zeros())
+        return tiled_gemm_ptg(A, B, C), dict(n=n, nb=nb)
+    if workload == "cholesky":
+        from ..data_dist.matrix import SymTwoDimBlockCyclic
+        from ..models.cholesky import tiled_cholesky_ptg
+        n, nb = n or 8192, nb or 512
+        A = SymTwoDimBlockCyclic("A", n, n, nb, nb, init_fn=zeros())
+        return tiled_cholesky_ptg(A), dict(n=n, nb=nb)
+    if workload == "lu":
+        from ..data_dist.matrix import TiledMatrix
+        from ..models.lu import tiled_lu_ptg
+        n, nb = n or 8192, nb or 512
+        A = TiledMatrix("A", n, n, nb, nb, dtype=np.float32,
+                        init_fn=zeros())
+        return tiled_lu_ptg(A), dict(n=n, nb=nb)
+    if workload == "stencil":
+        from ..data_dist.matrix import VectorTwoDimCyclic
+        from ..models.stencil import stencil_1d_ptg
+        n, mb = n or (1 << 24), nb or (1 << 18)
+        V = VectorTwoDimCyclic("V", lm=n, mb=mb, P=1,
+                               init_fn=lambda m, size:
+                               np.zeros(size, np.float32))
+        w = np.full(9, 1.0 / 9.0)
+        return stencil_1d_ptg(V, w, 64), dict(n=n, mb=mb)
+    if workload == "llm_decode":
+        from ..data.datatype import TileType
+        from ..data_dist.collection import DictCollection
+        from ..data_dist.paged_kv import PagedKVCollection
+        from ..llm.decode import decode_step_ptg
+        nseqs, npages = n or 8, nb or 4
+        kv = PagedKVCollection("KV", page_size=16)
+        H, D = kv.num_heads, kv.head_dim
+        Q = DictCollection("Q", dtt=TileType((3, H, D), np.float32))
+        O = DictCollection("O", dtt=TileType((H, D), np.float32))
+        seqs = [f"s{i}" for i in range(nseqs)]
+        for s in seqs:
+            kv.alloc_seq(s)
+            for _ in range(npages):
+                kv.alloc_page(s)
+            kv.note_appended(s, npages * kv.page_size - 1)
+            kv.ensure_tail_slot(s)
+        tp = decode_step_ptg(kv, Q, O, seqs, devices="auto")
+        return tp, dict(nseqs=nseqs, npages=npages)
+    raise ValueError(f"unknown warm workload {workload!r} (gemm, "
+                     f"cholesky, lu, stencil, llm_decode)")
+
+
+def warm_cache(workload: str, n: int | None = None, nb: int | None = None,
+               modes: tuple = ("auto", "region"),
+               budget_s: float | None = None) -> dict:
+    """Populate the persistent lowering/compile caches for one workload
+    ahead of a bench run (the r06+ fix for BENCH_r04/r05's compile-
+    deadline deaths): every requested mode traces + compiles AOT against
+    abstract avals, landing executables in JAX's persistent compilation
+    cache — a later process at the same geometry pays deserialization,
+    not XLA.  Returns per-mode timings."""
+    tp, geom = _warm_workload(workload, n, nb)
+    out: dict = {"workload": workload, **geom,
+                 "backend": list(_backend_signature())}
+    for mode in modes:
+        t0 = time.perf_counter()
+        try:
+            if mode == "region":
+                plan = lower_regions(tp)
+                st = plan.compile(budget_s=budget_s)
+                out["region"] = {k: st[k] for k in
+                                 ("regions", "regions_compiled",
+                                  "regions_eager", "trace_s", "compile_s")}
+            else:
+                low = lower_taskpool(tp, passes=mode)
+                out[mode] = {"mode": low.mode, **low.warm()}
+        except LoweringError as e:
+            out[mode] = {"error": str(e)}
+        out.setdefault("wall_s", {})[mode] = round(
+            time.perf_counter() - t0, 3)
+    return out
+
+
+def _main(argv: list[str] | None = None) -> int:
+    """``python -m parsec_tpu.ptg.lowering --warm <workload> [--n --nb]``
+    — the AOT cache-warming CLI (scripts/warm_cache.sh wraps it)."""
+    import argparse
+    import json
+    ap = argparse.ArgumentParser(
+        prog="python -m parsec_tpu.ptg.lowering",
+        description="AOT lowering/compile cache warmer: compile a "
+                    "workload's lowered programs into the persistent "
+                    "compilation cache before a bench run's stage clock "
+                    "starts (docs/PERF.md, 'Region lowering & compile "
+                    "budgets').")
+    ap.add_argument("--warm", metavar="WORKLOAD", required=True,
+                    help="gemm | cholesky | lu | stencil | llm_decode")
+    ap.add_argument("--n", type=int, default=None,
+                    help="problem size (stencil: vector length; "
+                    "llm_decode: sequence count)")
+    ap.add_argument("--nb", type=int, default=None,
+                    help="tile size (stencil: segment size; llm_decode: "
+                    "pages per sequence)")
+    ap.add_argument("--nt", type=int, default=None,
+                    help="tile count (alternative to --n: n = nt * nb)")
+    ap.add_argument("--modes", default="auto,region",
+                    help="comma list of lowering modes to warm "
+                    "(auto, wavefront, unrolled, chain-collapse, region)")
+    ap.add_argument("--budget", type=float, default=None,
+                    help="compile budget seconds for the region mode "
+                    "(default: the lowering_compile_budget_s MCA param)")
+    args = ap.parse_args(argv)
+    n = args.n
+    if n is None and args.nt is not None:
+        n = args.nt * (args.nb or 512)
+    out = warm_cache(args.warm, n=n, nb=args.nb,
+                     modes=tuple(m.strip() for m in args.modes.split(",")
+                                 if m.strip()),
+                     budget_s=args.budget)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    # under `python -m` runpy executes a FRESH module copy whose
+    # traceable registry the model modules never see — delegate to the
+    # canonical module object so registration and lookup share state
+    from parsec_tpu.ptg.lowering import _main as _canonical_main
+    raise SystemExit(_canonical_main())

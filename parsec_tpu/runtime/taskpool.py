@@ -45,6 +45,13 @@ class Taskpool:
         # per-pool termdet selection (JDF_PROP_TERMDET_NAME): overrides the
         # MCA param for this pool when set ("local", "user_trigger", ...)
         self.termdet_name: str | None = None
+        # megakernel region pools (ptg/lowering.lower_regions): the
+        # RegionLoweredTaskpool plan whose regions this pool's tasks
+        # execute — each task is one jitted subgraph program, runtime
+        # scheduling only at region boundaries.  Observability (stall
+        # dumps, runtime reports) and completion writeback key off it;
+        # None for ordinary task-grained pools.
+        self.region_plan: Any = None
         # PARSEC_SIM cost model: enabled when any class carries a simcost
         # expression; tracks the simulated critical path of the pool
         self.sim_enabled = False

@@ -33,6 +33,11 @@ else
     echo "== llm microbench (smoke: tokens/s through the serving stack) =="
     python -c 'import json, microbench; \
 print(json.dumps(microbench.bench_llm(smoke=True)))'
+
+    echo "== lowering microbench (XLA calls per DAG: dispatch/region/" \
+         "wavefront/chain + compile seconds) =="
+    python -c 'import json, microbench; \
+print(json.dumps(microbench.bench_lowering(smoke=True)))'
 fi
 
 echo "check.sh: all stages green"

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# AOT lowering/compile cache warmer (ISSUE 8): populate the persistent
+# lowering + XLA compilation caches BEFORE a bench run, so r06+ TPU
+# stages pay deserialization instead of the ~141s compiles that killed
+# BENCH_r04/r05 (rc 124).  See docs/PERF.md, "Region lowering & compile
+# budgets".
+#
+#   scripts/warm_cache.sh                        # default workload set
+#   scripts/warm_cache.sh cholesky gemm          # named workloads
+#   WARM_N=8192 WARM_NB=512 scripts/warm_cache.sh cholesky
+#   WARM_MODES=region WARM_BUDGET=120 scripts/warm_cache.sh cholesky
+#
+# The cache directory is PARSEC_TPU_COMPILE_CACHE_DIR (default
+# <tmp>/parsec-tpu-xla-cache) with a per-(jax version, backend) leaf, so
+# one dir can be shared by CPU and TPU processes safely.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORKLOADS=("$@")
+if [[ ${#WORKLOADS[@]} -eq 0 ]]; then
+    WORKLOADS=(gemm cholesky lu stencil)
+fi
+
+ARGS=()
+[[ -n "${WARM_N:-}" ]] && ARGS+=(--n "$WARM_N")
+[[ -n "${WARM_NB:-}" ]] && ARGS+=(--nb "$WARM_NB")
+[[ -n "${WARM_NT:-}" ]] && ARGS+=(--nt "$WARM_NT")
+[[ -n "${WARM_MODES:-}" ]] && ARGS+=(--modes "$WARM_MODES")
+[[ -n "${WARM_BUDGET:-}" ]] && ARGS+=(--budget "$WARM_BUDGET")
+
+for w in "${WORKLOADS[@]}"; do
+    echo "== warm: $w ==" >&2
+    python -m parsec_tpu.ptg.lowering --warm "$w" "${ARGS[@]}"
+done

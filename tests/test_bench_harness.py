@@ -280,3 +280,57 @@ def test_budget_exhausted_logs_and_uses_prior_taint(capsys):
         assert "budget" in err and "rr-budget" in err
     finally:
         bench._abandoned[:] = before
+
+
+def test_stage_budget_spec_parses_mca_env_grammar(param):
+    """bench_stage_budget_s (ISSUE 8 satellite): '<seconds>' rebudgets
+    every stage, 'name=sec' named ones, '*' the default."""
+    import bench
+    bench._stage_budgets()                 # first call registers the param
+    param("bench_stage_budget_s", "gemm=300, lowered_cholesky=240,*=45")
+    assert bench._stage_budgets() == {"gemm": 300.0,
+                                      "lowered_cholesky": 240.0, "*": 45.0}
+    param("bench_stage_budget_s", "75")
+    assert bench._stage_budgets() == {"*": 75.0}
+    param("bench_stage_budget_s", "")
+    assert bench._stage_budgets() == {}
+    param("bench_stage_budget_s", "gemm=nonsense")  # malformed: ignored
+    assert bench._stage_budgets() == {}
+
+
+def test_region_stage_budget_shed_completes_instead_of_rc124():
+    """ISSUE-8 acceptance, harness form: a region stage whose compile
+    budget can afford NOTHING must still complete inside its deadline —
+    regions shed to the eager path (stage done, correct result, no
+    compile_timeout), and the partial trail names the budget."""
+    import bench
+    from parsec_tpu.ptg.lowering import lowering_cache
+
+    lowering_cache.clear()                 # force a genuinely cold plan
+    res = bench._staged("region-shed", bench.bench_region_cholesky_gflops,
+                        n=512, nb=128, budget_s=1e-9, timeout=90.0)
+    assert "status" not in res and "error" not in res, res
+    assert res["gflops"] > 0
+    assert res["regions_eager"] >= 1 and res["regions_compiled"] == 0, res
+    assert res["compile_s"] == 0.0
+    assert res["tile00_abs_err"] < 1e-4
+    # ...and a warm second run compiles for free (the persistent-cache
+    # half of the acceptance line): same geometry, same tiny budget,
+    # but cache hits are never shed
+    res2 = bench._staged("region-warm", bench.bench_region_cholesky_gflops,
+                         n=512, nb=128, budget_s=1e-9, timeout=90.0)
+    assert "error" not in res2, res2
+    # the shed run never compiled, so the in-process cache is still cold
+    # for shed regions; a prior COMPILED plan is what warms it
+    bench.bench_region_cholesky_gflops(n=512, nb=128, budget_s=60.0)
+    res3 = bench._staged("region-warm2", bench.bench_region_cholesky_gflops,
+                         n=512, nb=128, budget_s=1e-9, timeout=90.0)
+    assert res3["regions_eager"] == 0, res3
+    assert res3["compile_s"] <= 0.01, res3
+
+
+def test_region_stage_lands_in_smoke_emit(smoke_run):
+    last = _json_lines(smoke_run[0].stdout)[-1]
+    assert last["extra"]["region_cholesky_gflops"] > 0
+    assert last["extra"]["region_cholesky_regions"] >= 1
+    assert last["extra"]["region_cholesky_eager"] == 0
