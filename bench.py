@@ -694,14 +694,19 @@ def bench_serve_stage() -> dict:
 def bench_llm_stage() -> dict:
     """The LLM inference-serving stage (microbench.bench_llm): tokens/s
     and per-token p50/p99 of the continuous batcher over paged-KV decode
-    pools on a hot RuntimeServer, swept over concurrent streams — the
-    request-scale axis the ROADMAP's millions-of-users north star needs
-    measured.  Pure scheduler+serve path on CPU: rides the relay-safe
-    group, so the axis has numbers whatever the accelerator weather."""
+    superpools on a hot RuntimeServer, swept over concurrent streams AND
+    over llm_steps_per_pool (the ISSUE-9 amortization axis, with
+    serve_submits_per_token making the k-steps -> 1/k-submits claim
+    directly visible).  Every swept point pre-flights through
+    _note_partial, so a mid-sweep deadline keeps the completed points
+    (the BENCH_r04/r05 lesson).  Pure scheduler+serve path on CPU:
+    rides the relay-safe group, so the axis has numbers whatever the
+    accelerator weather."""
     import os
 
     from microbench import bench_llm
-    out = bench_llm(smoke=os.environ.get("BENCH_SMOKE") == "1")
+    out = bench_llm(smoke=os.environ.get("BENCH_SMOKE") == "1",
+                    note=_note_partial)
     out["gflops"] = 0.0   # not a compute stage; keep the stage shape
     return out
 

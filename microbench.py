@@ -384,31 +384,49 @@ def bench_serve(nsub: int = 64, nthreads: int = 4, depth: int = 8,
     }
 
 
-def bench_llm(streams_sweep: tuple = (1, 4, 8), new_tokens: int = 16,
+def bench_llm(streams_sweep: tuple = (1, 4, 8),
+              steps_sweep: tuple = (1, 4, 8), new_tokens: int = 16,
               prompt_len: int = 8, nb_cores: int = 2,
-              smoke: bool = False) -> dict:
+              smoke: bool = False, note=None) -> dict:
     """The LLM serving axis: tokens/s and per-token p50/p99 latency of
     the continuous batcher on a hot RuntimeServer, swept over concurrent
-    streams (the request-scale axis the ROADMAP names).  Each stream is
-    a ToyLM generation riding paged-KV decode pools — per stream per
-    token that is one ragged ATTN chain + OUT through admission, WFQ,
-    live enqueue, and the dispatch path, so tokens/s is the serving
-    stack's end-to-end fixed cost (no accelerator; ``docs/LLM.md``)."""
+    streams (the request-scale axis the ROADMAP names) AND over
+    ``llm_steps_per_pool`` (the ISSUE-9 amortization axis: one k-step
+    decode superpool per tenant per iteration, in-graph sampling, so
+    submit/termdet overhead is paid 1/k per token).  Streams run under
+    per-stream tenants — the ROADMAP's millions-of-users shape, where
+    WFQ isolation is a hard boundary and cross-stream batching cannot
+    hide the per-pool submit cost, so the k axis measures exactly what
+    the superpool amortizes.  (PR 6 benched 2 shared tenants, whose
+    intra-tenant batching already amortized submits 4x at 8 streams;
+    that axis is still visible as the streams sweep.)  Each point also
+    reports ``submits_per_token`` — the amortization claim (k steps ->
+    1/k submits) made directly visible — and ``note(**kw)`` (the bench
+    harness passes ``_note_partial``) fires per swept point, so a
+    mid-sweep deadline keeps the completed points (the BENCH_r04/r05
+    lesson).  No accelerator; ``docs/LLM.md``."""
+    from parsec_tpu.core.params import params as _params
     from parsec_tpu.llm import ToyLM
     from parsec_tpu.serve import RuntimeServer
 
     if smoke:
-        streams_sweep, new_tokens = (1, 4), 8
+        streams_sweep, steps_sweep, new_tokens = (1, 4), (1, 8), 8
     model = ToyLM()
-    out: dict = {"llm_streams_sweep": {}}
+    out: dict = {"llm_streams_sweep": {}, "llm_steps_sweep": {}}
+    k_top = max(steps_sweep)
+    saved_k = _params.get("llm_steps_per_pool")
     server = RuntimeServer(nb_cores=nb_cores)
     try:
-        for ns in streams_sweep:
+        def run_point(ns: int, k: int) -> dict:
+            _params.set("llm_steps_per_pool", k)
+            before = server.stats().get("llm") or {}
+            sub0 = before.get("decode_submits", 0)
+            tok0 = before.get("tokens_generated", 0)
             prompts = [[(7 * i + 3 * j) % model.vocab
                         for j in range(prompt_len)] for i in range(ns)]
             t0 = time.perf_counter()
             tks = [server.submit_stream(p, max_new_tokens=new_tokens,
-                                        tenant=f"tenant{i % 2}")
+                                        tenant=f"tenant{i}")
                    for i, p in enumerate(prompts)]
             per_token: list[float] = []
             for tk in tks:
@@ -416,20 +434,47 @@ def bench_llm(streams_sweep: tuple = (1, 4, 8), new_tokens: int = 16,
             wall = time.perf_counter() - t0
             per_token.sort()
             n = len(per_token)
-            out["llm_streams_sweep"][str(ns)] = {
+            after = server.stats()["llm"]
+            d_sub = after["decode_submits"] - sub0
+            d_tok = after["tokens_generated"] - tok0
+            point = {
                 "tokens_per_s": round(ns * new_tokens / wall, 1),
                 "p50_ms": round(per_token[n // 2] * 1e3, 3),
                 "p99_ms": round(
                     per_token[min(int(n * 0.99), n - 1)] * 1e3, 3),
+                "submits_per_token": round(d_sub / max(1, d_tok), 4),
             }
-        top = out["llm_streams_sweep"][str(streams_sweep[-1])]
-        out["llm_tokens_per_s"] = top["tokens_per_s"]
-        out["llm_p50_ms"] = top["p50_ms"]
-        out["llm_p99_ms"] = top["p99_ms"]
+            if note is not None:
+                # one UNIQUE key per swept point: _note_partial merges
+                # by dict update, so reusing flat keys would leave only
+                # the last completed point in a deadline's degrade
+                # record instead of all of them
+                note(phase="llm", **{f"llm_point_s{ns}_k{k}": point})
+            return point
+
+        for ns in streams_sweep:
+            out["llm_streams_sweep"][str(ns)] = run_point(ns, k_top)
+        top_ns = streams_sweep[-1]
+        # the amortization axis, measured IN THE SAME RUN at the top
+        # stream count (k_top reuses the streams-sweep point)
+        for k in steps_sweep:
+            out["llm_steps_sweep"][str(k)] = (
+                out["llm_streams_sweep"][str(top_ns)] if k == k_top
+                else run_point(top_ns, k))
+        base = out["llm_steps_sweep"][str(min(steps_sweep))]
+        best = out["llm_steps_sweep"][str(k_top)]
+        out["llm_superpool_speedup"] = round(
+            best["tokens_per_s"] / max(base["tokens_per_s"], 1e-9), 2)
+        out["llm_tokens_per_s"] = best["tokens_per_s"]
+        out["llm_p50_ms"] = best["p50_ms"]
+        out["llm_p99_ms"] = best["p99_ms"]
+        out["llm_steps_per_pool"] = k_top
+        out["serve_submits_per_token"] = best["submits_per_token"]
         out["llm_new_tokens"] = new_tokens
         out["llm_prompt_len"] = prompt_len
         out["llm_kv"] = server.stats()["llm"]["kv"]
     finally:
+        _params.set("llm_steps_per_pool", saved_k)
         server.drain(timeout=60)
     return out
 

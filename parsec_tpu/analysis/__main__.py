@@ -75,6 +75,28 @@ def _model_graphs(nt: int):
         kv.ensure_tail_slot(seq)
     yield "llm_decode", decode_step_ptg(kv, Q, O, list(prompts))
 
+    # the k-step decode superpool (ISSUE 9): in-graph SAMPLE chains,
+    # cross-step tail-page dataflow, mixed per-seq step counts — the
+    # ragged multi-step graph the batcher submits per tenant iteration
+    from ..llm import decode_superpool_ptg, preallocate_decode_steps
+    kv2 = PagedKVCollection("KVk", page_size=4, num_heads=H, head_dim=D)
+    chunks2 = {}
+    for seq, toks in prompts.items():
+        kv2.alloc_seq(seq)
+        chunks2.update(prefill_chunks(model, kv2, seq, toks[:-1]))
+    Q2 = DictCollection("Qk", dtt=TileType((3, H, D), np.float32))
+    O2 = DictCollection("Ok", dtt=TileType((H, D), np.float32))
+    TOK = DictCollection("TOKk", dtt=TileType((3,), np.float32))
+    EMB = DictCollection("EMBk", dtt=TileType(model.q3_table().shape,
+                                              np.float32))
+    steps = {"a": max(2, nt // 2), "b": 2}      # mixed step counts
+    for seq in prompts:
+        preallocate_decode_steps(kv2, seq, steps[seq])
+        TOK.data_of(seq, -1)                    # the chain seed tile
+    yield "llm_decode_k", decode_superpool_ptg(
+        kv2, Q2, O2, TOK, EMB, list(prompts),
+        [steps[s] for s in prompts])
+
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
@@ -84,8 +106,8 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--graph", metavar="MODEL|JDF",
                     help="verify one graph: a model name (cholesky, lu, "
                          "pingpong, reduction, stencil1d, stencil2d, "
-                         "tiled_gemm, all2all, llm_prefill, llm_decode) "
-                         "or a .jdf path")
+                         "tiled_gemm, all2all, llm_prefill, llm_decode, "
+                         "llm_decode_k) or a .jdf path")
     ap.add_argument("--bind", action="append", default=[],
                     metavar="NAME=INT", help="JDF global binding")
     ap.add_argument("--nt", type=int, default=5,

@@ -70,6 +70,16 @@ class DictCollection(DataCollection):
         self._store: dict[tuple, Data] = {}
         self._lock = threading.Lock()
 
+    @property
+    def open_key_space(self) -> bool:
+        """Whether this collection's key space is OPEN (no declared
+        ``keys=``): ``has_key`` answers True for anything and new keys
+        materialize on first touch — consumers that pre-plan storage
+        (the taskpool→XLA lowering) must keep room to extend, even when
+        some keys are already materialized (ISSUE 9: the token-chain
+        collection is seeded before the pool writes fresh keys)."""
+        return self._keys is None
+
     def rank_of(self, *key) -> int:
         if self._rank_of_fn is not None:
             return self._rank_of_fn(*key)

@@ -6,9 +6,12 @@ continuous batching over a :class:`~parsec_tpu.serve.RuntimeServer`
 
 from ..data_dist.paged_kv import PagedKVCollection
 from .batcher import ContinuousBatcher, StreamTicket
-from .decode import decode_step_ptg, prefill_chunks, prefill_ptg
+from .decode import (decode_step_ptg, decode_superpool_ptg,
+                     preallocate_decode_steps, prefill_chunks, prefill_ptg,
+                     read_token_chain, seed_decode_superpool)
 from .model import ToyLM
 
 __all__ = ["PagedKVCollection", "ToyLM", "ContinuousBatcher",
-           "StreamTicket", "decode_step_ptg", "prefill_ptg",
-           "prefill_chunks"]
+           "StreamTicket", "decode_step_ptg", "decode_superpool_ptg",
+           "preallocate_decode_steps", "prefill_ptg", "prefill_chunks",
+           "read_token_chain", "seed_decode_superpool"]

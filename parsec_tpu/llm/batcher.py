@@ -6,24 +6,36 @@ primitives: clients open *streams* (:meth:`ContinuousBatcher
 batcher thread runs the decode loop::
 
     each iteration:
-      admit newly-arrived streams   -> prefill pools (PF tasks)
-      group live streams by tenant  -> ONE decode-step pool per tenant
-      submit all pools concurrently -> server.submit(tenant=...)
-      await tickets, read O, sample -> next token per stream
+      admit newly-arrived streams   -> submit prefill pools (PF tasks)
+      group live streams by tenant  -> ONE k-step decode SUPERPOOL per
+                                       tenant (llm_steps_per_pool)
+      await decode, read TOK tiles  -> k tokens per stream per submit
+      await prefill (it OVERLAPPED the decode superpool), join streams
       retire finished streams       -> kv.free_seq (pages recycle)
 
-New streams join at the next iteration boundary and finished streams
-leave without stalling the batch — continuous batching, with the
-runtime's admission control bounding the in-flight pools and the WFQ
-fair scheduler arbitrating decode pools against each other and against
+The superpool (ISSUE 9) is the amortization move: sampling runs
+IN-GRAPH (the SAMPLE task class, ``llm/decode.decode_superpool_ptg``),
+so one pool spans ``llm_steps_per_pool`` autoregressive iterations and
+the per-pool submit/termdet overhead (~1-2 ms) is paid once per k
+tokens, not once per token.  EOS and early-finishing streams ride
+predicated step bodies — a finished stream's remaining tasks no-op, so
+it wastes at most its own tail tasks.  Prefill pools for arriving
+streams are submitted BEFORE the decode superpools are awaited, so a
+long prompt's chunked prefill overlaps a whole k-step iteration instead
+of stalling it; new streams join at the next iteration boundary and
+finished streams leave without stalling the batch — with admission
+control bounding in-flight pools and WFQ arbitrating decode against
 whatever dense-linear-algebra tenants share the server (the soak test
 mixes decode with a Cholesky pool, ``tests/test_llm_serve.py``).
 
-Every decode-step pool is a fresh PTG taskpool: the live re-enqueue
-path PR 3 built (``Context.add_taskpool`` under ``_submit_lock``) runs
-once per token batch, and terminated pools retire from the process
-registry (``runtime/taskpool.py``) so a million-token serving run's
-footprint stays bounded by LIVE streams, not by history.
+Every superpool is a fresh PTG taskpool: the live re-enqueue path PR 3
+built (``Context.add_taskpool`` under ``_submit_lock``) runs once per
+k-token batch, and terminated pools retire from the process registry
+(``runtime/taskpool.py``) so a million-token serving run's footprint
+stays bounded by LIVE streams, not by history.  ``fork_from=`` forks a
+stream's prompt KV copy-on-write from an already-admitted stream with
+the same prompt (``PagedKVCollection.fork``): N continuations share ONE
+physical copy of the prompt pages until their first divergent write.
 """
 
 from __future__ import annotations
@@ -41,7 +53,9 @@ from ..core.params import params as _params
 from ..data.datatype import TileType
 from ..data_dist.collection import DictCollection
 from ..data_dist.paged_kv import PagedKVCollection
-from .decode import decode_step_ptg, prefill_chunks, prefill_ptg
+from .decode import (decode_superpool_ptg, preallocate_decode_steps,
+                     prefill_chunks, prefill_ptg, read_token_chain,
+                     seed_emb_table, seed_stream_step)
 from .model import ToyLM
 
 _params.register("llm_page_size", 16,
@@ -54,6 +68,26 @@ _params.register("llm_max_pages", 4096,
 _params.register("llm_step_timeout", 60.0,
                  "seconds the batcher waits for one decode-step pool "
                  "before failing the streams riding it")
+_params.register("llm_steps_per_pool", 8,
+                 "autoregressive decode steps one superpool spans (the "
+                 "in-graph SAMPLE class carries token -> next query "
+                 "between steps): the host loop and its submit/termdet "
+                 "overhead run once per k tokens; 1 = the PR-6 "
+                 "step-per-pool behavior")
+_params.register("llm_compiled_pools", True,
+                 "submit decode superpools through the funneled "
+                 "compiled-DAG executor (runtime/dagrun.py, PR 2's "
+                 "native select->release loop) instead of the dynamic "
+                 "scheduler: lowest per-task overhead, at the cost of "
+                 "task-grain WFQ interleaving WITHIN a pool (tenant "
+                 "fairness still applies across pools)")
+_params.register("llm_lower_regions", False,
+                 "region-lower each decode superpool (ptg.lowering."
+                 "lower_regions) before submission: per-step XLA "
+                 "dispatches collapse into one jitted program per "
+                 "verified region (compile cost rides the lowering "
+                 "cache / AOT warming; pools that cannot lower fall "
+                 "back to the dynamic path)")
 
 
 class StreamTicket:
@@ -100,11 +134,12 @@ class StreamTicket:
 
 class _Stream:
     __slots__ = ("seq", "tenant", "priority", "prompt", "max_new",
-                 "ticket", "cur", "devices")
+                 "ticket", "cur", "devices", "eos", "fork_from", "k")
 
     def __init__(self, seq: Any, tenant: str, priority: int,
                  prompt: Sequence[int], max_new: int,
-                 ticket: StreamTicket) -> None:
+                 ticket: StreamTicket, eos: int | None = None,
+                 fork_from: "_Stream | None" = None) -> None:
         self.seq = seq
         self.tenant = tenant
         self.priority = priority
@@ -112,6 +147,9 @@ class _Stream:
         self.max_new = max_new
         self.ticket = ticket
         self.cur = int(prompt[-1])
+        self.eos = None if eos is None else int(eos)
+        self.fork_from = fork_from      # CoW prompt-KV parent (or None)
+        self.k = 1                      # steps the current superpool runs
 
 
 class ContinuousBatcher:
@@ -134,6 +172,15 @@ class ContinuousBatcher:
             "model and KV cache disagree on head geometry"
         self.Q = DictCollection("llmQ", dtt=TileType((3, H, D), np.float32))
         self.O = DictCollection("llmO", dtt=TileType((H, D), np.float32))
+        # the in-graph SAMPLE class's side collections (ISSUE 9): TOK
+        # carries the per-step [token, done, eos] chain tiles the host
+        # reads once per superpool; EMB holds the precomputed q3 stack
+        # table the SAMPLE kernel computes logits/next-queries from
+        # (one gather per token — ToyLM.q3_table)
+        self.TOK = DictCollection("llmTOK", dtt=TileType((3,), np.float32))
+        self.EMB = DictCollection(
+            "llmEMB", dtt=TileType(self.model.q3_table().shape, np.float32))
+        seed_emb_table(self.model, self.EMB)
         self.max_batch = max_batch or _params.get("llm_max_batch")
         self.devices = devices
         self._lock = threading.Lock()
@@ -146,6 +193,8 @@ class ContinuousBatcher:
         self.steps = 0
         self.tokens_generated = 0
         self.streams_completed = 0
+        self.decode_submits = 0         # superpool submits (1/k per token)
+        self.forked_streams = 0         # streams whose prompt KV forked
         self._pool_seq = itertools.count()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="llm-batcher")
@@ -154,17 +203,45 @@ class ContinuousBatcher:
     # -- client API ------------------------------------------------------
     def submit_stream(self, prompt_tokens: Sequence[int],
                       max_new_tokens: int = 16, tenant: str = "default",
-                      priority: int = 0) -> StreamTicket:
+                      priority: int = 0, eos: int | None = None,
+                      fork_from: StreamTicket | None = None
+                      ) -> StreamTicket:
         """Open one generation stream; it joins the running batch at the
-        next iteration boundary."""
+        next iteration boundary.
+
+        ``eos`` stops generation early when sampled (the EOS token is
+        the last one kept; handled in-graph by the predicated SAMPLE
+        bodies, so a mid-superpool finish wastes no other stream's
+        work).  ``fork_from`` names an earlier stream's ticket with the
+        SAME prompt: the new stream forks its prompt KV copy-on-write
+        (``PagedKVCollection.fork``) instead of re-prefilling — N
+        continuations of one prompt hold one physical copy of the
+        prompt pages until their first divergent write.  When the
+        parent has already advanced past its prompt (or retired), the
+        fork silently falls back to a normal prefill."""
         if not prompt_tokens:
             raise ValueError("prompt_tokens must be non-empty")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        parent = None
+        if fork_from is not None:
+            parent = getattr(fork_from, "_stream", None)
+            # identity, not shape: another batcher's seq ids collide
+            # with ours, so a foreign ticket could fork an UNRELATED
+            # local sequence's pages
+            if parent is None or getattr(fork_from, "_batcher",
+                                         None) is not self:
+                raise ValueError("fork_from must be a StreamTicket from "
+                                 "this batcher")
+            if parent.prompt != list(prompt_tokens):
+                raise ValueError("fork_from requires an identical prompt "
+                                 "(the shared-prefix pages ARE the fork)")
         seq = next(self._seq_ids)
         ticket = StreamTicket(f"stream{seq}", tenant)
         st = _Stream(seq, tenant, priority, prompt_tokens,
-                     max_new_tokens, ticket)
+                     max_new_tokens, ticket, eos=eos, fork_from=parent)
+        ticket._stream = st
+        ticket._batcher = self
         with self._lock:
             if self._stop:
                 # typed shed, same contract as server.submit: clients
@@ -184,6 +261,8 @@ class ContinuousBatcher:
                 "steps": self.steps,
                 "tokens_generated": self.tokens_generated,
                 "streams_completed": self.streams_completed,
+                "decode_submits": self.decode_submits,
+                "forked_streams": self.forked_streams,
                 "kv": self.kv.stats(),
             }
 
@@ -221,13 +300,19 @@ class ContinuousBatcher:
                     self._wake.wait(0.05)
                     self._wake.clear()
                     continue
-                if fresh:
-                    ok = self._prefill(fresh)
-                    with self._lock:
-                        self._live.extend(ok)
-                        live = list(self._live)
+                # chunked-prefill interleave (ISSUE 9): arrivals' prefill
+                # pools are SUBMITTED first, the live streams' k-step
+                # decode superpools run while prefill is in flight, and
+                # only then are the prefill tickets awaited — a long
+                # prompt overlaps a whole decode iteration instead of
+                # stalling it.  Fresh streams join at the NEXT boundary.
+                pf = self._prefill_submit(fresh) if fresh else None
                 if live:
                     self._decode_step(live)
+                if pf is not None:
+                    ok = self._prefill_await(pf)
+                    with self._lock:
+                        self._live.extend(ok)
         except BaseException as e:      # noqa: BLE001 — fail the streams,
             self._fail_all(e)           # never leave clients blocked
 
@@ -259,12 +344,16 @@ class ContinuousBatcher:
 
     def _release_stream_state(self, seq: Any) -> None:
         """Everything a retired sequence held: KV pages back to the free
-        list, its Q/O side tiles dropped — the serving footprint must be
-        bounded by LIVE streams, not by every stream ever served.  Safe
-        for a never-allocated seq (all no-ops)."""
+        list, its Q/O side tiles and TOK chain tiles dropped — the
+        serving footprint must be bounded by LIVE streams, not by every
+        stream ever served.  Safe for a never-allocated seq (all
+        no-ops)."""
         self.kv.free_seq(seq)
         self.Q.discard(seq)
         self.O.discard(seq)
+        for key in self.TOK.known_keys():
+            if key and key[0] == seq:
+                self.TOK.discard(*key)
 
     def _fail_all(self, e: BaseException) -> None:
         with self._lock:
@@ -275,14 +364,41 @@ class ContinuousBatcher:
             st.ticket._fail(e)
             self._release_stream_state(st.seq)
 
-    def _prefill(self, fresh: list[_Stream]) -> list[_Stream]:
-        """Write the new streams' prompt K/V into fresh pages, grouped
-        into one PF pool per tenant.  Returns the streams that made it —
-        an exhausted page budget fails ONE stream, a shed pool fails ONE
-        tenant's arrivals, never the whole batch."""
+    def _fork_ready(self, parent: _Stream) -> bool:
+        """Whether a fork parent's cache is EXACTLY its prompt prefix
+        (prefilled, not yet decoded) — the only window where forking the
+        block table IS forking the prompt.  A retired parent is never
+        ready even when its seq still exists: a FAILED parent's page
+        release may be deferred behind a timed-out zombie pool that is
+        still writing them, and the host-side ledger (advanced at chunk
+        time, before any pool ran) cannot tell the difference."""
+        if parent.ticket.done():
+            return False
+        try:
+            return self.kv.seq_len(parent.seq) == len(parent.prompt) - 1
+        except KeyError:                 # parent retired / never admitted
+            return False
+
+    def _prefill_submit(self, fresh: list[_Stream]) -> dict:
+        """Phase 1 of the chunked-prefill interleave: allocate pages and
+        SUBMIT one PF pool per tenant, without awaiting — the caller
+        runs the decode superpools while these are in flight.  An
+        exhausted page budget fails ONE stream, a shed pool fails ONE
+        tenant's arrivals, never the whole batch.  Fork-on-prompt
+        children skip prefill entirely and resolve in
+        :meth:`_prefill_await` once their parent's pages are real."""
         stream_chunks: dict[Any, dict[tuple, np.ndarray]] = {}
         by_tenant: dict[str, list[_Stream]] = {}
+        forks: list[_Stream] = []
+        fresh_ids = {id(st) for st in fresh}
         for st in fresh:
+            parent = st.fork_from
+            if parent is not None and (id(parent) in fresh_ids
+                                       or self._fork_ready(parent)):
+                st.ticket.state = "prefill"
+                forks.append(st)
+                continue
+            st.fork_from = None          # parent advanced: plain prefill
             try:
                 self.kv.alloc_seq(st.seq)
                 stream_chunks[st.seq] = prefill_chunks(
@@ -295,6 +411,7 @@ class ContinuousBatcher:
         t0 = time.perf_counter()
         tickets: list[tuple[Any, Any, list[_Stream]]] = []
         ok: list[_Stream] = []
+        done_t: dict[int, float] = {}
         for tenant, group in by_tenant.items():
             seqs = [st.seq for st in group if self.kv.npages(st.seq) > 0]
             if not seqs:
@@ -314,12 +431,29 @@ class ContinuousBatcher:
                     keys=list(chunks))
                 tp = prefill_ptg(self.kv, T, seqs, devices=self.devices,
                                  name=f"llm_prefill{next(self._pool_seq)}")
+                # timestamp the pool's ACTUAL completion: the interleave
+                # awaits only after the decode superpools, so awaiting
+                # time would inflate prefill_s by a whole iteration
+                tp.add_completion_listener(
+                    lambda _tp, _d=done_t, _k=id(tp):
+                    _d.setdefault(_k, time.perf_counter()))
                 tickets.append((self._server.submit(
                     tp, tenant=tenant,
                     priority=max(st.priority for st in group)), tp, group))
             except BaseException as e:       # noqa: BLE001 — contain
                 self._retire_failed(group, e)
-        for tk, tp, group in tickets:
+        return {"t0": t0, "tickets": tickets, "ok": ok, "forks": forks,
+                "fresh_ids": fresh_ids, "done_t": done_t}
+
+    def _prefill_await(self, state: dict) -> list[_Stream]:
+        """Phase 2: await the PF tickets, then resolve fork children —
+        their parent's pages are real now, so ``PagedKVCollection.fork``
+        shares them copy-on-write (no bytes move).  Returns the streams
+        that join the live batch."""
+        ok: list[_Stream] = list(state["ok"])
+        for st in ok:
+            st.ticket.prefill_s = 0.0     # single-token: nothing cached
+        for tk, tp, group in state["tickets"]:
             try:
                 tk.result(timeout=_params.get("llm_step_timeout"))
             except BaseException as e:       # noqa: BLE001 — contain
@@ -327,27 +461,99 @@ class ContinuousBatcher:
                 # release rides its completion, not this failure
                 self._retire_failed(group, e, defer_pool=tp)
                 continue
+            # prefill cost = submit -> the pool's own completion stamp,
+            # NOT this (post-decode) await instant
+            dt = state["done_t"].get(
+                id(tp), time.perf_counter()) - state["t0"]
+            for st in group:
+                st.ticket.prefill_s = dt
             ok.extend(group)
-        dt = time.perf_counter() - t0
-        for st in ok:
-            st.ticket.prefill_s = dt
-            st.ticket.state = "decoding"
-        return ok
-
-    def _decode_step(self, live: list[_Stream]) -> None:
-        """One continuous-batching iteration over every live stream.
-        Failures are contained per stream (slot allocation) or per
-        tenant (pool shed/failure) — the rest of the batch decodes on."""
-        ready: list[_Stream] = []
-        for st in live:
+        ok_ids = {id(st) for st in ok}
+        fallback: list[_Stream] = []
+        for st in state["forks"]:
+            parent = st.fork_from
+            # an in-batch parent must have actually COMPLETED its PF
+            # pool: the host-side length ledger advances at chunk time,
+            # BEFORE the pool runs, so _fork_ready alone cannot prove
+            # the parent's pages hold real bytes (a timed-out PF pool
+            # may still be writing them).  An out-of-batch parent must
+            # still sit exactly at its prompt boundary — it may have
+            # run a decode superpool since phase 1 classified us.
+            # Either miss takes the documented silent fallback: the
+            # child re-prefills its own prompt like any fresh stream.
+            if id(parent) in state["fresh_ids"]:
+                ready = id(parent) in ok_ids
+            else:
+                ready = self._fork_ready(parent)
+            if not ready:
+                st.fork_from = None
+                fallback.append(st)
+                continue
             try:
-                self.kv.ensure_tail_slot(st.seq)
-                q = self.Q.data_of(st.seq).get_copy(0)
-                q.value = self.model.q3(st.cur)
-                q.version += 1
+                self.kv.fork(parent.seq, st.seq)
             except BaseException as e:       # noqa: BLE001 — contain
                 self._retire_failed([st], e)
                 continue
+            # never consulted post-fork: clearing it unpins the parent
+            # _Stream chain (prompt, ticket, token lists) so footprint
+            # stays bounded by LIVE streams even for fork-of-fork trees
+            # whose leaf tickets clients keep alive
+            st.fork_from = None
+            st.ticket.prefill_s = 0.0     # CoW share: no bytes moved
+            with self._lock:
+                self.forked_streams += 1
+            ok_ids.add(id(st))       # a fork of a fork resolves in order
+            ok.append(st)
+        if fallback:
+            # fork_from is cleared, so the batch produces no new forks
+            # and this recursion terminates after one level (and sets
+            # the fallback streams' own prefill_s)
+            ok.extend(self._prefill_await(self._prefill_submit(fallback)))
+        for st in ok:
+            st.ticket.state = "decoding"
+        return ok
+
+    def _maybe_lower_regions(self, tp: Any) -> Any:
+        """Opt-in (``llm_lower_regions``): compile the superpool into
+        megakernel regions (PR 8, ``ptg.lowering.lower_regions``) and
+        submit the REGION pool instead — per-step XLA dispatches
+        collapse into one jitted program per verified region, on top of
+        the 1/k submit amortization.  The lowering cache and AOT warming
+        (``scripts/warm_cache.sh llm_decode_k``) make repeat geometries
+        compile-free; anything the lowering refuses runs the dynamic
+        path unchanged."""
+        if not _params.get("llm_lower_regions"):
+            return tp
+        from ..ptg.lowering import LoweringError, lower_regions
+        try:
+            plan = lower_regions(tp)
+            plan.compile()
+            table = plan.materialize_table()
+            return plan.taskpool(table)
+        except LoweringError:
+            return tp
+
+    def _decode_step(self, live: list[_Stream]) -> None:
+        """One continuous-batching iteration: ONE k-step decode
+        superpool per tenant over its live streams, with k =
+        ``llm_steps_per_pool`` clipped to each stream's remaining
+        budget.  Sampling runs in-graph (the SAMPLE class), so the host
+        reads k tokens off the TOK chain tiles per submit instead of
+        re-entering the runtime per token.  Failures are contained per
+        stream (slot allocation) or per tenant (pool shed/failure) —
+        the rest of the batch decodes on."""
+        k_max = max(1, int(_params.get("llm_steps_per_pool")))
+        ready: list[_Stream] = []
+        for st in live:
+            k = max(1, min(k_max, st.max_new - len(st.ticket.tokens)))
+            try:
+                preallocate_decode_steps(self.kv, st.seq, k)
+                seed_stream_step(self.model, self.Q, self.TOK, st.seq,
+                                 st.cur, eos=st.eos)
+            except BaseException as e:       # noqa: BLE001 — contain
+                self._retire_failed([st], e)
+                continue
+            st.k = k
             ready.append(st)
         by_tenant: dict[str, list[_Stream]] = {}
         for st in ready:
@@ -356,13 +562,19 @@ class ContinuousBatcher:
         submitted: list[tuple[Any, Any, list[_Stream]]] = []
         for tenant, group in by_tenant.items():
             try:
-                tp = decode_step_ptg(
-                    self.kv, self.Q, self.O, [st.seq for st in group],
+                tp = decode_superpool_ptg(
+                    self.kv, self.Q, self.O, self.TOK, self.EMB,
+                    [st.seq for st in group], [st.k for st in group],
                     devices=self.devices,
                     name=f"llm_decode{next(self._pool_seq)}")
+                tp = self._maybe_lower_regions(tp)
                 submitted.append((self._server.submit(
                     tp, tenant=tenant,
-                    priority=max(st.priority for st in group)), tp, group))
+                    priority=max(st.priority for st in group),
+                    compiled=bool(_params.get("llm_compiled_pools"))),
+                    tp, group))
+                with self._lock:
+                    self.decode_submits += 1
             except BaseException as e:       # noqa: BLE001 — contain
                 self._retire_failed(group, e)
         finished: list[_Stream] = []
@@ -376,15 +588,21 @@ class ContinuousBatcher:
                 continue
             dt = time.perf_counter() - t0
             for st in group:
-                o = np.asarray(
-                    self.O.data_of(st.seq).newest_copy().value)
-                st.cur = self.model.sample(o)
-                self.kv.note_appended(st.seq)
+                # tokens past a mid-superpool EOS are the predicated
+                # tail — read_token_chain never surfaces them
+                toks, done = read_token_chain(self.TOK, st.seq, st.k)
+                for t_i in range(st.k):
+                    self.TOK.discard(st.seq, t_i)
+                # the ledger advances by the FULL k: the OUT bodies
+                # appended every step's k/v (predication holds tokens,
+                # not appends), and a done stream's pages free anyway
+                self.kv.note_appended(st.seq, st.k)
+                st.cur = toks[-1]
                 with self._lock:
-                    st.ticket.tokens.append(st.cur)
-                    st.ticket.per_token_s.append(dt)
-                    self.tokens_generated += 1
-                if len(st.ticket.tokens) >= st.max_new:
+                    st.ticket.tokens.extend(toks)
+                    st.ticket.per_token_s.extend([dt] * len(toks))
+                    self.tokens_generated += len(toks)
+                if done or len(st.ticket.tokens) >= st.max_new:
                     finished.append(st)
         with self._lock:
             self.steps += 1

@@ -154,6 +154,235 @@ def decode_step_ptg(kv: PagedKVCollection, Q: DictCollection,
     return p.build()
 
 
+def preallocate_decode_steps(kv: PagedKVCollection, seq: Any,
+                             k: int) -> None:
+    """Make ``k`` autoregressive write slots real BEFORE the superpool is
+    built: token positions are deterministic (``seq_len .. seq_len+k-1``),
+    so every tail page the k steps will touch can be allocated — and a
+    fork-shared tail copy-on-write privatized — at build time.  (The
+    builder re-derives the per-step page schedule itself from the
+    ledger; this only has to make the pages exist.)"""
+    if k < 1:
+        raise ValueError("k must be >= 1")
+    P = kv.page_size
+    L0 = kv.seq_len(seq)
+    kv.ensure_tail_slot(seq)            # CoW-privatize + first write page
+    last_page = (L0 + k - 1) // P
+    while kv.npages(seq) <= last_page:
+        kv.alloc_page(seq)              # fresh pages are private + zeroed
+
+
+def decode_superpool_ptg(kv: PagedKVCollection, Q: DictCollection,
+                         O: DictCollection, TOK: DictCollection,
+                         EMB: DictCollection, seqs: Sequence[Any],
+                         steps: Sequence[int], devices: str = "cpu",
+                         name: str = "llm_superpool") -> ptg.PTGTaskpool:
+    """ONE PTG pool spanning ``steps[i]`` autoregressive decode
+    iterations for each listed sequence — the k-step superpool (ISSUE 9).
+
+    Per step t of sequence s::
+
+        ATTN(s,t,p)  online-softmax of q(s,t) over page p, ACC threading
+        OUT(s,t)     finalize -> SAMPLE; append q-token k/v to the tail
+        SAMPLE(s,t)  in-graph greedy argmax over OUT's logits: writes
+                     TOK(s,t) (the token the host reads) and feeds the
+                     NEXT step's query q3(token) to ATTN/OUT(s,t+1)
+
+    The host loop runs once per k tokens instead of once per token: the
+    per-pool submit/termdet overhead (~1-2 ms) amortizes 1/k, and the
+    whole k-step DAG is one graphcheck-verified region-lowerable graph.
+
+    Callers must have (a) preallocated every step's write slot
+    (:func:`preallocate_decode_steps` — positions are deterministic),
+    (b) seeded ``Q(seq)`` with the current token's q3 stack and
+    ``TOK(seq, -1)`` with ``[token, 0, eos]`` (``eos < 0`` = disabled),
+    and (c) loaded ``EMB(0,)`` with the model's precomputed q3 stack
+    table (:meth:`~parsec_tpu.llm.model.ToyLM.q3_table`).  EOS
+    and early-finishing streams are handled by predicated step bodies
+    (:func:`~parsec_tpu.ops.ragged_attention.sample_step_np`): a
+    finished stream's remaining tasks run but change nothing, so a
+    mid-superpool finish wastes at most its own tail tasks.
+    """
+    P = kv.page_size
+    NS = len(seqs)
+    S = tuple(int(k) for k in steps)
+    if len(S) != NS or any(k < 1 for k in S):
+        raise ValueError("steps must give every sequence >= 1 step")
+    L0 = tuple(kv.seq_len(s) for s in seqs)
+    # deterministic per-(seq, step) schedule: NP[t] pages attended, WP[t]
+    # the append page, LW[t][p] the last step < t writing page p (-1:
+    # frozen — read straight from the collection), RD[t] the later steps
+    # whose ATTN re-reads the page OUT(t) wrote
+    NP, WP, LW, RD = [], [], [], []
+    for si, s in enumerate(seqs):
+        wp_s = tuple((L0[si] + t) // P for t in range(S[si]))
+        np_s = tuple(w + 1 for w in wp_s)
+        if kv.npages(s) < np_s[-1]:
+            raise ValueError(
+                f"superpool needs preallocate_decode_steps() first: "
+                f"seq {s!r} has {kv.npages(s)} pages, its {S[si]}-step "
+                f"schedule needs {np_s[-1]}")
+        lw_s = []
+        for t in range(S[si]):
+            lw_s.append(tuple(
+                max((tp_ for tp_ in range(t) if wp_s[tp_] == p),
+                    default=-1)
+                for p in range(np_s[t])))
+        rd_s = tuple(tuple(tt for tt in range(t + 1, S[si])
+                           if lw_s[tt][wp_s[t]] == t)
+                     for t in range(S[si]))
+        NP.append(np_s)
+        WP.append(wp_s)
+        LW.append(tuple(lw_s))
+        RD.append(rd_s)
+    H, D = kv.num_heads, kv.head_dim
+    p = ptg.PTGBuilder(name, KV=kv, Q=Q, O=O, TOK=TOK, EMB=EMB,
+                       SEQS=tuple(seqs), NS=NS, S=S, NP=tuple(NP),
+                       WP=tuple(WP), LW=tuple(LW), RD=tuple(RD))
+
+    t = p.task("ATTN",
+               s=ptg.span(0, lambda g, l: g.NS - 1),
+               t=lambda g, l: range(g.S[l.s]),
+               p=lambda g, l: range(g.NP[l.s][l.t]))
+    t.affinity("KV", lambda g, l: (g.SEQS[l.s], l.p))
+    # drain earlier steps and long page chains first: the critical path
+    t.priority(lambda g, l: (g.S[l.s] - l.t) * 1024
+               + g.NP[l.s][l.t] - l.p)
+    fq = t.flow("Q", ptg.READ)
+    fq.input(data=("Q", lambda g, l: (g.SEQS[l.s],)),
+             guard=lambda g, l: l.t == 0)
+    fq.input(pred=("SAMPLE", "QN",
+                   lambda g, l: {"s": l.s, "t": l.t - 1}),
+             guard=lambda g, l: l.t > 0)
+    fkv = t.flow("KV", ptg.READ)
+    fkv.input(data=("KV", lambda g, l: (g.SEQS[l.s], l.p)),
+              guard=lambda g, l: g.LW[l.s][l.t][l.p] < 0)
+    fkv.input(pred=("OUT", "KVW",
+                    lambda g, l: {"s": l.s, "t": g.LW[l.s][l.t][l.p]}),
+              guard=lambda g, l: g.LW[l.s][l.t][l.p] >= 0)
+    facc = t.flow("ACC", ptg.RW, dtt=TileType((H, D + 2), np.float32))
+    facc.input(new=True, guard=lambda g, l: l.p == 0)
+    facc.input(pred=("ATTN", "ACC",
+                     lambda g, l: {"s": l.s, "t": l.t, "p": l.p - 1}),
+               guard=lambda g, l: l.p > 0)
+    facc.output(succ=("ATTN", "ACC",
+                      lambda g, l: {"s": l.s, "t": l.t, "p": l.p + 1}),
+                guard=lambda g, l: l.p < g.NP[l.s][l.t] - 1)
+    facc.output(succ=("OUT", "ACC", lambda g, l: {"s": l.s, "t": l.t}),
+                guard=lambda g, l: l.p == g.NP[l.s][l.t] - 1)
+
+    def attn_body(es: Any, task: Any, g: Any, l: Any) -> None:
+        acc = task.flow_data("ACC")
+        acc.value = ra.attn_page_update_np(
+            np.asarray(task.flow_data("Q").value),
+            np.asarray(task.flow_data("KV").value),
+            np.asarray(acc.value))
+        acc.version += 1
+
+    if devices in ("auto", "tpu"):
+        t.body(device="tpu", dyld="ragged_attn_page")
+    t.body(attn_body, dyld="ragged_attn_page")
+
+    o = p.task("OUT", s=ptg.span(0, lambda g, l: g.NS - 1),
+               t=lambda g, l: range(g.S[l.s]))
+    o.affinity("KV", lambda g, l: (g.SEQS[l.s], g.WP[l.s][l.t]))
+    o.priority(lambda g, l: (g.S[l.s] - l.t) * 1024)
+    foacc = o.flow("ACC", ptg.READ)
+    foacc.input(pred=("ATTN", "ACC",
+                      lambda g, l: {"s": l.s, "t": l.t,
+                                    "p": g.NP[l.s][l.t] - 1}))
+    foq = o.flow("Q", ptg.READ)
+    foq.input(data=("Q", lambda g, l: (g.SEQS[l.s],)),
+              guard=lambda g, l: l.t == 0)
+    foq.input(pred=("SAMPLE", "QN",
+                    lambda g, l: {"s": l.s, "t": l.t - 1}),
+              guard=lambda g, l: l.t > 0)
+    fkvw = o.flow("KVW", ptg.RW)
+    fkvw.input(data=("KV", lambda g, l: (g.SEQS[l.s], g.WP[l.s][l.t])),
+               guard=lambda g, l: l.t == 0
+               or g.WP[l.s][l.t] != g.WP[l.s][l.t - 1])
+    fkvw.input(pred=("OUT", "KVW",
+                     lambda g, l: {"s": l.s, "t": l.t - 1}),
+               guard=lambda g, l: l.t > 0
+               and g.WP[l.s][l.t] == g.WP[l.s][l.t - 1])
+    fkvw.output(data=("KV", lambda g, l: (g.SEQS[l.s], g.WP[l.s][l.t])))
+    fkvw.output(succ=("OUT", "KVW",
+                      lambda g, l: {"s": l.s, "t": l.t + 1}),
+                guard=lambda g, l: l.t + 1 < g.S[l.s]
+                and g.WP[l.s][l.t + 1] == g.WP[l.s][l.t])
+    fkvw.output(succ=("ATTN", "KV",
+                      lambda g, l: [{"s": l.s, "t": tt,
+                                     "p": g.WP[l.s][l.t]}
+                                    for tt in g.RD[l.s][l.t]]),
+                guard=lambda g, l: bool(g.RD[l.s][l.t]))
+    fo = o.flow("O", ptg.WRITE, dtt=TileType((H, D), np.float32))
+    fo.input(new=True)
+    fo.output(succ=("SAMPLE", "O", lambda g, l: {"s": l.s, "t": l.t}))
+    fo.output(data=("O", lambda g, l: (g.SEQS[l.s],)),
+              guard=lambda g, l: l.t == g.S[l.s] - 1)
+
+    def out_body(es: Any, task: Any, g: Any, l: Any) -> None:
+        kvw = task.flow_data("KVW")
+        oc = task.flow_data("O")
+        new_page, out = ra.attn_out_np(
+            np.asarray(task.flow_data("ACC").value),
+            np.asarray(task.flow_data("Q").value),
+            np.asarray(kvw.value))
+        kvw.value = new_page
+        kvw.version += 1
+        oc.value = out
+        oc.version += 1
+
+    if devices in ("auto", "tpu"):
+        o.body(device="tpu", dyld="ragged_attn_out")
+    o.body(out_body, dyld="ragged_attn_out")
+
+    sm = p.task("SAMPLE", s=ptg.span(0, lambda g, l: g.NS - 1),
+                t=lambda g, l: range(g.S[l.s]))
+    sm.affinity("KV", lambda g, l: (g.SEQS[l.s], g.WP[l.s][l.t]))
+    sm.priority(lambda g, l: (g.S[l.s] - l.t) * 1024)
+    fso = sm.flow("O", ptg.READ)
+    fso.input(pred=("OUT", "O", lambda g, l: {"s": l.s, "t": l.t}))
+    fst = sm.flow("TOK", ptg.RW, dtt=TileType((3,), np.float32))
+    fst.input(data=("TOK", lambda g, l: (g.SEQS[l.s], -1)),
+              guard=lambda g, l: l.t == 0)
+    fst.input(pred=("SAMPLE", "TOK",
+                    lambda g, l: {"s": l.s, "t": l.t - 1}),
+              guard=lambda g, l: l.t > 0)
+    fst.output(data=("TOK", lambda g, l: (g.SEQS[l.s], l.t)))
+    fst.output(succ=("SAMPLE", "TOK",
+                     lambda g, l: {"s": l.s, "t": l.t + 1}),
+               guard=lambda g, l: l.t < g.S[l.s] - 1)
+    fse = sm.flow("EMB", ptg.READ)
+    fse.input(data=("EMB", lambda g, l: (0,)))
+    fsq = sm.flow("QN", ptg.WRITE, dtt=TileType((3, H, D), np.float32))
+    fsq.input(new=True)
+    fsq.output(succ=("ATTN", "Q",
+                     lambda g, l: [{"s": l.s, "t": l.t + 1, "p": pp}
+                                   for pp in range(g.NP[l.s][l.t + 1])]),
+               guard=lambda g, l: l.t < g.S[l.s] - 1)
+    fsq.output(succ=("OUT", "Q",
+                     lambda g, l: {"s": l.s, "t": l.t + 1}),
+               guard=lambda g, l: l.t < g.S[l.s] - 1)
+
+    def sample_body(es: Any, task: Any, g: Any, l: Any) -> None:
+        tok = task.flow_data("TOK")
+        qn = task.flow_data("QN")
+        tok_new, qn_new = ra.sample_step_np(
+            np.asarray(task.flow_data("O").value),
+            np.asarray(tok.value),
+            np.asarray(task.flow_data("EMB").value))
+        tok.value = tok_new
+        tok.version += 1
+        qn.value = qn_new
+        qn.version += 1
+
+    if devices in ("auto", "tpu"):
+        sm.body(device="tpu", dyld="llm_sample")
+    sm.body(sample_body, dyld="llm_sample")
+    return p.build()
+
+
 def prefill_chunks(model: Any, kv: PagedKVCollection, seq: Any,
                    tokens: Sequence[int]) -> dict[tuple, np.ndarray]:
     """Host-side prefill prep: allocate ``seq``'s pages for ``tokens``
@@ -174,3 +403,72 @@ def prefill_chunks(model: Any, kv: PagedKVCollection, seq: Any,
         chunks[(seq, c)] = tile
     kv.note_appended(seq, n)
     return chunks
+
+
+def seed_emb_table(model: Any, EMB: DictCollection) -> None:
+    """Load ``EMB(0,)`` with the model's precomputed ``(V, 3, H, D)``
+    q3 stack table — the tile the in-graph SAMPLE class computes logits
+    and next-step queries from (one gather per token)."""
+    ec = EMB.data_of(0).get_copy(0)
+    ec.value = np.array(model.q3_table(), copy=True)
+    ec.version += 1
+
+
+def seed_stream_step(model: Any, Q: DictCollection, TOK: DictCollection,
+                     seq: Any, token: int, *,
+                     eos: int | None = None) -> None:
+    """Seed ONE stream's per-iteration inputs: ``Q(seq)`` with the
+    current token's q3 stack and ``TOK(seq, -1)`` with the
+    ``[token, done=0, eos]`` chain-seed tile (``eos < 0`` = disabled) —
+    the layout contract the SAMPLE bodies read.  The batcher calls this
+    per stream per superpool; if the layout changes, it changes HERE
+    and in the kernel, nowhere else."""
+    qc = Q.data_of(seq).get_copy(0)
+    qc.value = model.q3(token)
+    qc.version += 1
+    t0 = TOK.data_of(seq, -1).get_copy(0)
+    t0.value = np.array([float(token), 0.0,
+                         -1.0 if eos is None else float(eos)],
+                        np.float32)
+    t0.version += 1
+
+
+def seed_decode_superpool(model: Any, kv: PagedKVCollection,
+                          Q: DictCollection, TOK: DictCollection,
+                          EMB: DictCollection,
+                          prompts: dict[Any, Sequence[int]],
+                          steps: dict[Any, int], *,
+                          eos: int | None = None) -> None:
+    """Host-side prep that makes :func:`decode_superpool_ptg`'s input
+    contract executable: prefill each prompt's pages in place (no
+    runtime), preallocate every step's write slot, and seed the
+    collections through the same :func:`seed_emb_table` /
+    :func:`seed_stream_step` the batcher uses.  Pool-level tests build
+    on this instead of re-deriving the seeding contract."""
+    seed_emb_table(model, EMB)
+    for seq, prompt in prompts.items():
+        kv.alloc_seq(seq)
+        for key, tile in prefill_chunks(model, kv, seq,
+                                        prompt[:-1]).items():
+            pg = kv.data_of(*key).get_copy(0)
+            pg.value = np.array(tile, copy=True)
+            pg.version += 1
+        preallocate_decode_steps(kv, seq, steps[seq])
+        seed_stream_step(model, Q, TOK, seq, prompt[-1], eos=eos)
+
+
+def read_token_chain(TOK: DictCollection, seq: Any,
+                     k: int) -> tuple[list[int], bool]:
+    """Read a sequence's k-step TOK chain the way the batcher does:
+    tokens past the step whose done flag fired are the predicated tail
+    and are never surfaced.  Returns ``(tokens, done)`` — ``done`` is
+    the last surfaced step's flag, so an EOS on the final step still
+    reads as finished."""
+    toks: list[int] = []
+    done = False
+    for t in range(k):
+        v = np.asarray(TOK.data_of(seq, t).newest_copy().value)
+        if not done:
+            toks.append(int(round(float(v[0]))))
+            done = bool(v[1] > 0.5)
+    return toks, done

@@ -44,9 +44,24 @@ class ToyLM:
 
     def q3(self, token: int) -> np.ndarray:
         """The ``(3, H, D)`` q/k/v stack of one token — the Q-collection
-        tile the decode pools read (``llm/decode.py``)."""
-        e = self.emb[int(token) % self.vocab]
-        return np.stack([e, np.roll(e, 1, axis=-1), e[..., ::-1]])
+        tile the decode pools read (``llm/decode.py``).  A fresh array,
+        never a view into the cached table: the caller may hand it to a
+        Data copy whose consumers write in place."""
+        return self.q3_table()[int(token) % self.vocab].copy()
+
+    def q3_table(self) -> np.ndarray:
+        """The full ``(vocab, 3, H, D)`` q/k/v stack table, built once —
+        the EMB tile the in-graph SAMPLE class reads (ISSUE 9): logits
+        come from channel 0 (``table[:, 0] · o``) and the next step's
+        query is ONE gather ``table[token]``, so the per-token roll/
+        reverse transforms never run on the serving hot path."""
+        t = getattr(self, "_q3_table", None)
+        if t is None:
+            e = self.emb
+            t = np.stack([e, np.roll(e, 1, axis=-1), e[..., ::-1]],
+                         axis=1).astype(np.float32)
+            self._q3_table = t
+        return t
 
     def sample(self, o: np.ndarray) -> int:
         """Greedy: argmax of ``o · E^T`` (deterministic — the serving
@@ -56,9 +71,13 @@ class ToyLM:
         return int(np.argmax(logits))
 
     def reference_generate(self, prompt: Sequence[int],
-                           max_new_tokens: int) -> list[int]:
+                           max_new_tokens: int,
+                           eos: int | None = None) -> list[int]:
         """Dense, unpaged decode loop — the oracle the paged pools and
-        the continuous batcher must match exactly."""
+        the continuous batcher must match exactly.  ``eos`` stops the
+        stream early: the EOS token is the last one kept (the same rule
+        the in-graph SAMPLE class predicates on, ``ops/ragged_attention
+        .sample_step_np``)."""
         if not prompt:
             raise ValueError("prompt must be non-empty")
         ks: list[np.ndarray] = []
@@ -77,4 +96,6 @@ class ToyLM:
             vs.append(q3[2])
             cur = self.sample(o)
             out.append(cur)
+            if eos is not None and cur == int(eos):
+                break
         return out

@@ -55,24 +55,26 @@ def attn_page_update_np(q3: np.ndarray, page: np.ndarray,
     """
     H, Dp2 = acc.shape
     D = Dp2 - 2
-    q = np.asarray(q3[0], np.float32)
-    k = np.asarray(page[0], np.float32)
-    v = np.asarray(page[1], np.float32)
     fill = int(page[2, 0, 0, 0])
-    P = k.shape[0]
-    scores = np.einsum("phd,hd->ph", k, q) / np.sqrt(D)      # (P, H)
-    valid = (np.arange(P) < fill)[:, None]
-    scores = np.where(valid, scores, NEG_INF)
+    if fill <= 0:
+        # empty page: nothing to fold in — the masked math below would
+        # produce exactly acc (weights all zero), so skip the whole pass
+        return np.array(acc, np.float32, copy=True)
+    q = np.asarray(q3[0], np.float32)
+    # slice to the filled slots instead of masking the whole page: the
+    # invalid rows would get weight 0 anyway, and this body runs once
+    # per (task, page) on the serving hot path — einsum's argument
+    # parsing alone costs more than the contraction at decode tile sizes
+    k = np.asarray(page[0][:fill], np.float32)
+    v = np.asarray(page[1][:fill], np.float32)
+    scores = (k * q).sum(axis=2) / np.sqrt(D)                # (fill, H)
     l_prev = acc[:, D + 1]
     m_prev = np.where(l_prev > 0, acc[:, D], NEG_INF)
     m_new = np.maximum(m_prev, scores.max(axis=0))
-    # explicit valid mask on the weights: with an all-empty page AND an
-    # empty accumulator m_new stays NEG_INF and exp(0)=1 would count the
-    # invalid slots
-    w = np.where(valid, np.exp(scores - m_new[None, :]), 0.0)
+    w = np.exp(scores - m_new[None, :])
     alpha = np.exp(m_prev - m_new)                           # <= 1
     out = np.empty((H, Dp2), np.float32)
-    out[:, :D] = acc[:, :D] * alpha[:, None] + np.einsum("ph,phd->hd", w, v)
+    out[:, :D] = acc[:, :D] * alpha[:, None] + (w[:, :, None] * v).sum(axis=0)
     out[:, D] = m_new
     out[:, D + 1] = l_prev * alpha + w.sum(axis=0)
     return out
@@ -101,6 +103,56 @@ def attn_out_np(acc: np.ndarray, q3: np.ndarray,
     page[1, fill] = q3[2]
     page[2, 0, 0, 0] = fill + 1
     return page, o
+
+
+def sample_step_np(o: np.ndarray, tok_prev: np.ndarray,
+                   q3t: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """The in-graph SAMPLE body: greedy argmax of ``o · E^T`` plus the
+    next step's query stack — the host's per-token work (ToyLM.sample +
+    q3) moved inside the decode DAG so a k-step superpool never
+    re-enters the host loop between tokens (ISSUE 9).
+
+    ``q3t``: the model's precomputed ``(vocab, 3, H, D)`` q/k/v stack
+    table (:meth:`ToyLM.q3_table` — channel 0 IS the embedding, so
+    logits are ``q3t[:, 0] · o`` and the next query is one gather).
+    ``tok_prev``: the ``(3,)`` token-chain tile ``[token, done, eos]``
+    threading step t-1 → t (``eos < 0`` disables EOS).  A stream that
+    already finished (``done``) holds its token — the predicated step
+    body: the remaining tasks run but change nothing, so a mid-superpool
+    EOS wastes at most the stream's own tail tasks.  Returns
+    ``(tok_tile, q3_next)``.
+    """
+    V = q3t.shape[0]
+    done_p = bool(tok_prev[1] > 0.5)
+    eos = float(tok_prev[2])
+    logits = q3t[:, 0].reshape(V, -1) @ np.asarray(
+        o, np.float32).reshape(-1)
+    samp = float(np.argmax(logits))
+    tok = float(tok_prev[0]) if done_p else samp
+    done = 1.0 if (done_p or (eos >= 0.0 and tok == eos)) else 0.0
+    return (np.array([tok, done, eos], np.float32),
+            q3t[int(tok) % V])
+
+
+def _sample_jnp(o: Any, tok_prev: Any, q3t: Any,
+                qn_scratch: Any = None) -> Any:
+    """jnp twin of :func:`sample_step_np` — the traceable incarnation the
+    region lowering and the vmapped same-class dispatch batch over
+    (``qn_scratch`` is the QN flow's zeros tile, unused — flow-order
+    contract, like ``_out_update_jnp``'s ``o_scratch``)."""
+    import jax.numpy as jnp
+    V = q3t.shape[0]
+    tok_prev = jnp.asarray(tok_prev, jnp.float32)
+    done_p = tok_prev[1] > 0.5
+    eos = tok_prev[2]
+    logits = q3t[:, 0].reshape(V, -1).astype(jnp.float32) @ jnp.asarray(
+        o, jnp.float32).reshape(-1)
+    samp = jnp.argmax(logits).astype(jnp.float32)
+    tok = jnp.where(done_p, tok_prev[0], samp)
+    done = jnp.where(done_p | ((eos >= 0.0) & (tok == eos)), 1.0, 0.0)
+    qn = q3t[tok.astype(jnp.int32) % V]
+    return (jnp.stack([tok, done, eos]).astype(jnp.float32),
+            qn.astype(jnp.float32))
 
 
 def ragged_attention_reference(q: np.ndarray, ks: np.ndarray,
@@ -160,6 +212,7 @@ def _out_update_jnp(acc: Any, q3: Any, page: Any, o_scratch: Any) -> Any:
 
 register_traceable("ragged_attn_page", _page_update_jnp)
 register_traceable("ragged_attn_out", _out_update_jnp)
+register_traceable("llm_sample", _sample_jnp)
 
 
 # ---------------------------------------------------------------------------
@@ -249,8 +302,27 @@ def _load_out_body() -> Any:
     return body
 
 
+def _load_sample_body() -> Any:
+    import jax
+    fn = jax.jit(_sample_jnp)
+
+    def body(es: Any, task: Any, device: Any) -> Any:
+        # flow order: O, TOK, EMB, QN (llm/decode.py decode_superpool_ptg)
+        tok, qn = task.data[1], task.data[3]
+        tok_new, qn_new = fn(task.data[0].value, tok.value,
+                             task.data[2].value, qn.value)
+        tok.value = tok_new
+        tok.version += 1
+        qn.value = qn_new
+        qn.version += 1
+        return tok_new
+
+    return body
+
+
 register_lazy_kernel("ragged_attn_page", "tpu", _load_page_body)
 register_lazy_kernel("ragged_attn_out", "tpu", _load_out_body)
+register_lazy_kernel("llm_sample", "tpu", _load_sample_body)
 
 
 # CPU dyld entries (DTD bodies may name them; the PTG pools attach the
@@ -275,5 +347,17 @@ def _out_body_cpu(es: Any, task: Any) -> None:
     o.version += 1
 
 
+def _sample_body_cpu(es: Any, task: Any) -> None:
+    tok, qn = task.data[1], task.data[3]
+    tok_new, qn_new = sample_step_np(np.asarray(task.data[0].value),
+                                     np.asarray(tok.value),
+                                     np.asarray(task.data[2].value))
+    tok.value = tok_new
+    tok.version += 1
+    qn.value = qn_new
+    qn.version += 1
+
+
 register_kernel("ragged_attn_page", "cpu", _page_body_cpu)
 register_kernel("ragged_attn_out", "cpu", _out_body_cpu)
+register_kernel("llm_sample", "cpu", _sample_body_cpu)

@@ -411,20 +411,33 @@ class _Stores:
             keys = _collection_keys(dc)
         except LoweringError:
             keys = []                   # non-enumerable: open key space
-        if not keys:
+        # an undeclared dict collection is open even when some keys are
+        # already materialized (a seeded token chain, ISSUE 9): the pool
+        # may write fresh keys its has_key oracle vouches for.  Multi-
+        # rank lowering keeps the closed snapshot — open spaces have no
+        # enumerable ownership to shard by.
+        open_space = (not keys or bool(getattr(dc, "open_key_space",
+                                               False)))
+        if open_space and self.nranks is None:
             # open store (paged-KV block tables, writeback-only dict
-            # collections): rows materialize on first reference through
-            # the collection's own has_key/data_of oracles
-            if self.nranks is not None:
-                raise LoweringError(
-                    f"collection {name}: open key spaces do not lower "
-                    f"multi-rank (no enumerable ownership)")
+            # collections): rows beyond the pre-registered ones
+            # materialize on first reference through the collection's
+            # own has_key/data_of oracles
             self.dcs[name] = dc
-            self.rows[name] = {}
-            self.nrows[name] = 0
+            self.rows[name] = {_norm_key(k): i for i, k in enumerate(keys)}
+            self.nrows[name] = len(keys)
             self.layout[name] = "stacked"
             self.open.add(name)
+            if keys:
+                first = np.asarray(
+                    dc.data_of(*keys[0]).newest_copy().value)
+                self.shape[name] = tuple(first.shape)
+                self.dtype[name] = first.dtype
             return
+        if not keys:
+            raise LoweringError(
+                f"collection {name}: open key spaces do not lower "
+                f"multi-rank (no enumerable ownership)")
         shapes = {dc.tile_shape(*k) if hasattr(dc, "tile_shape")
                   else np.asarray(dc.data_of(*k).newest_copy().value).shape
                   for k in keys}
@@ -2100,8 +2113,41 @@ def _warm_workload(workload: str, n: int | None, nb: int | None):
             kv.ensure_tail_slot(s)
         tp = decode_step_ptg(kv, Q, O, seqs, devices="auto")
         return tp, dict(nseqs=nseqs, npages=npages)
+    if workload == "llm_decode_k":
+        # the k-step decode superpool (ISSUE 9): n = sequences, nb =
+        # steps per pool — warming it AOT is what keeps the serving
+        # path's region-lowered incarnation (llm_lower_regions) from
+        # paying XLA at first-token time
+        from ..data.datatype import TileType
+        from ..data_dist.collection import DictCollection
+        from ..data_dist.paged_kv import PagedKVCollection
+        from ..llm.decode import (decode_superpool_ptg,
+                                  preallocate_decode_steps)
+        from ..llm.model import ToyLM
+        nseqs, ksteps = n or 8, nb or 8
+        model = ToyLM()
+        kv = PagedKVCollection("KV", page_size=16,
+                               num_heads=model.num_heads,
+                               head_dim=model.head_dim)
+        H, D = kv.num_heads, kv.head_dim
+        Q = DictCollection("Q", dtt=TileType((3, H, D), np.float32))
+        O = DictCollection("O", dtt=TileType((H, D), np.float32))
+        TOK = DictCollection("TOK", dtt=TileType((3,), np.float32))
+        EMB = DictCollection("EMB", dtt=TileType(
+            model.q3_table().shape, np.float32))
+        seqs = [f"s{i}" for i in range(nseqs)]
+        for s in seqs:
+            kv.alloc_seq(s)
+            for _ in range(3):
+                kv.alloc_page(s)
+            kv.note_appended(s, 3 * kv.page_size - 1)
+            preallocate_decode_steps(kv, s, ksteps)
+            TOK.data_of(s, -1)          # materialize the chain seed
+        tp = decode_superpool_ptg(kv, Q, O, TOK, EMB, seqs,
+                                  [ksteps] * nseqs, devices="auto")
+        return tp, dict(nseqs=nseqs, steps=ksteps)
     raise ValueError(f"unknown warm workload {workload!r} (gemm, "
-                     f"cholesky, lu, stencil, llm_decode)")
+                     f"cholesky, lu, stencil, llm_decode, llm_decode_k)")
 
 
 def warm_cache(workload: str, n: int | None = None, nb: int | None = None,
@@ -2148,13 +2194,15 @@ def _main(argv: list[str] | None = None) -> int:
                     "starts (docs/PERF.md, 'Region lowering & compile "
                     "budgets').")
     ap.add_argument("--warm", metavar="WORKLOAD", required=True,
-                    help="gemm | cholesky | lu | stencil | llm_decode")
+                    help="gemm | cholesky | lu | stencil | llm_decode | "
+                         "llm_decode_k")
     ap.add_argument("--n", type=int, default=None,
                     help="problem size (stencil: vector length; "
-                    "llm_decode: sequence count)")
+                    "llm_decode/llm_decode_k: sequence count)")
     ap.add_argument("--nb", type=int, default=None,
                     help="tile size (stencil: segment size; llm_decode: "
-                    "pages per sequence)")
+                    "pages per sequence; llm_decode_k: steps per "
+                    "superpool)")
     ap.add_argument("--nt", type=int, default=None,
                     help="tile count (alternative to --n: n = nt * nb)")
     ap.add_argument("--modes", default="auto,region",

@@ -358,14 +358,22 @@ class RuntimeServer:
         return self.submit(p.build(), **kw)
 
     def submit_stream(self, prompt_tokens, *, max_new_tokens: int = 16,
-                      tenant: str = "default", priority: int = 0):
+                      tenant: str = "default", priority: int = 0,
+                      eos: int | None = None, fork_from=None):
         """Open an LLM generation stream — the session abstraction over
         this server's continuous batcher (``parsec_tpu/llm/batcher.py``;
         ``docs/LLM.md``).  The first call creates the batcher (paged KV
         cache + decode loop thread); every stream then rides the
-        iteration-level batch: per-step decode pools submitted under the
-        stream's ``tenant``, so WFQ arbitrates decode against any other
-        workload this server carries.  Returns a
+        iteration-level batch: k-step decode superpools submitted under
+        the stream's ``tenant``, so WFQ arbitrates decode against any
+        other workload this server carries.  ``eos`` stops generation
+        when sampled (handled in-graph by the predicated SAMPLE bodies);
+        ``fork_from`` names an earlier stream's ticket with the same
+        prompt — the new stream forks its prompt KV copy-on-write
+        (``PagedKVCollection.fork``) instead of re-prefilling, so N
+        continuations of one prompt share one physical copy of the
+        prompt pages until their first divergent write
+        (``docs/SERVING.md``).  Returns a
         :class:`~parsec_tpu.llm.batcher.StreamTicket`."""
         with self._lock:
             if self._draining or self._poison is not None:
@@ -378,7 +386,8 @@ class RuntimeServer:
             llm = self._llm
         return llm.submit_stream(prompt_tokens,
                                  max_new_tokens=max_new_tokens,
-                                 tenant=tenant, priority=priority)
+                                 tenant=tenant, priority=priority,
+                                 eos=eos, fork_from=fork_from)
 
     # -- completion / failure -------------------------------------------
     def _on_pool_done(self, tp: Taskpool) -> None:

@@ -30,7 +30,8 @@ else
     echo "== runtimelint + graphcheck (every shipped model graph) =="
     python -m parsec_tpu.analysis
 
-    echo "== llm microbench (smoke: tokens/s through the serving stack) =="
+    echo "== llm microbench (smoke: tokens/s through the serving stack," \
+         "swept over llm_steps_per_pool — superpool amortization) =="
     python -c 'import json, microbench; \
 print(json.dumps(microbench.bench_llm(smoke=True)))'
 

@@ -16,9 +16,12 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# llm_decode_k is the k-step decode superpool's region program (ISSUE 9):
+# warming it is what keeps a region-lowered serving path
+# (--mca llm_lower_regions 1) from paying XLA at first-token time
 WORKLOADS=("$@")
 if [[ ${#WORKLOADS[@]} -eq 0 ]]; then
-    WORKLOADS=(gemm cholesky lu stencil)
+    WORKLOADS=(gemm cholesky lu stencil llm_decode_k)
 fi
 
 ARGS=()

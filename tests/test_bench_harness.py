@@ -174,6 +174,30 @@ def test_compile_deadline_death_records_typed_partial_entry():
         bench._abandoned[:] = prior
 
 
+def test_llm_mid_sweep_deadline_keeps_all_completed_points():
+    """ISSUE-9 satellite: bench_llm notes every swept (streams, k) point
+    under a UNIQUE key (``_note_partial`` merges by dict update), so a
+    deadline death mid-sweep degrades to a record carrying ALL the
+    completed points — not just the last one."""
+    import bench
+
+    def fake_llm_stage():
+        bench._note_partial(phase="llm",
+                            llm_point_s8_k1={"tokens_per_s": 400.0})
+        bench._note_partial(phase="llm",
+                            llm_point_s8_k8={"tokens_per_s": 1600.0})
+        time.sleep(30)
+
+    prior = list(bench._abandoned)
+    try:
+        res = bench._staged("fakellm", fake_llm_stage, timeout=0.3)
+        assert res["status"] == "timeout", res
+        assert res["partial"]["llm_point_s8_k1"]["tokens_per_s"] == 400.0
+        assert res["partial"]["llm_point_s8_k8"]["tokens_per_s"] == 1600.0
+    finally:
+        bench._abandoned[:] = prior
+
+
 def test_lowered_stages_report_compile_seconds(smoke_run):
     last = _json_lines(smoke_run[0].stdout)[-1]
     assert last["extra"]["lowered_cholesky_compile_s"] > 0

@@ -1,5 +1,6 @@
 """The LLM inference subsystem: paged KV cache, ragged attention
-kernels, prefill/decode task pools, continuous batching (ISSUE 6;
+kernels, prefill/decode task pools, k-step decode superpools with
+in-graph sampling, continuous batching (ISSUES 6 + 9;
 ``docs/LLM.md``)."""
 
 import numpy as np
@@ -9,7 +10,9 @@ from parsec_tpu.data.datatype import TileType
 from parsec_tpu.data_dist.collection import DictCollection
 from parsec_tpu.data_dist.paged_kv import PagedKVCollection
 from parsec_tpu.llm import (ContinuousBatcher, ToyLM, decode_step_ptg,
-                            prefill_chunks, prefill_ptg)
+                            decode_superpool_ptg, prefill_chunks,
+                            prefill_ptg, read_token_chain,
+                            seed_decode_superpool)
 from parsec_tpu.ops import ragged_attention as ra
 from parsec_tpu.runtime import Context
 from parsec_tpu.serve import RuntimeServer
@@ -285,6 +288,106 @@ def test_decode_through_tpu_device_tier_with_lru_residency(accel_device):
 
 
 # ---------------------------------------------------------------------------
+# k-step decode superpools: in-graph SAMPLE chains (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+def _superpool_setup(prompts, steps, devices="cpu", eos=None):
+    """Build the side collections and run the library's own
+    per-iteration prep (``seed_decode_superpool`` — the batcher's
+    seeding contract, stated once) for pool-level tests."""
+    kv = _kv()
+    Q = DictCollection("Q", dtt=TileType((3, H, D), np.float32))
+    O = DictCollection("O", dtt=TileType((H, D), np.float32))
+    TOK = DictCollection("TOK", dtt=TileType((3,), np.float32))
+    EMB = DictCollection("EMB", dtt=TileType(MODEL.q3_table().shape,
+                                             np.float32))
+    seed_decode_superpool(MODEL, kv, Q, TOK, EMB, prompts, steps, eos=eos)
+    tp = decode_superpool_ptg(kv, Q, O, TOK, EMB, list(prompts),
+                              [steps[s] for s in prompts],
+                              devices=devices)
+    return kv, TOK, tp
+
+
+def _tokens_of(TOK, seq, k):
+    return read_token_chain(TOK, seq, k)[0]
+
+
+def test_superpool_matches_reference_mixed_steps_and_page_boundaries():
+    """One pool spanning k autoregressive steps per sequence — DIFFERENT
+    k per sequence, with the token positions crossing page boundaries
+    mid-pool (page_size 4), must equal the dense oracle token for
+    token.  This is the ISSUE-9 tentpole contract: SAMPLE threads token
+    -> next query in-graph, OUT threads the tail page across steps."""
+    prompts = {"a": [3, 7, 11, 5, 9, 2], "b": [1, 40], "c": [8, 8, 2, 6]}
+    steps = {"a": 7, "b": 5, "c": 1}
+    kv, TOK, tp = _superpool_setup(prompts, steps)
+    report = tp.validate()
+    assert not report.errors and not report.warnings, report
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+    for seq, prompt in prompts.items():
+        want = MODEL.reference_generate(prompt, steps[seq])
+        assert _tokens_of(TOK, seq, steps[seq]) == want, seq
+
+
+def test_superpool_eos_mid_pool_predicated_tail_is_discarded():
+    """A sequence sampling EOS at an interior step finishes THERE: the
+    surfaced tokens equal the EOS-truncated oracle, and the predicated
+    tail tasks ran without corrupting the other sequence's chain."""
+    ref = MODEL.reference_generate([3, 7, 11, 5], 8)
+    eos = ref[1]                       # fires mid-pool
+    want = MODEL.reference_generate([3, 7, 11, 5], 8, eos=eos)
+    assert 1 <= len(want) < 8
+    prompts = {"a": [3, 7, 11, 5], "b": [1, 40]}
+    steps = {"a": 8, "b": 8}
+    kv, TOK, tp = _superpool_setup(prompts, steps, eos=eos)
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+    assert _tokens_of(TOK, "a", 8) == want
+    # the un-finished stream is untouched by a's early exit (b never
+    # samples eos in 8 steps of this prompt — checked via the oracle)
+    want_b = MODEL.reference_generate([1, 40], 8, eos=eos)
+    assert _tokens_of(TOK, "b", 8) == want_b
+
+
+def test_superpool_through_device_tier_with_pallas_interpret(
+        accel_device, param):
+    """The ISSUE-9 satellite gating arxiv 2604.15464 end-to-end off-TPU:
+    the FULL k-step pools-vs-oracle token-equality test with the ATTN
+    page kernel resolved through the Pallas build (interpret mode) —
+    not just the kernel-level incarnation equality."""
+    from parsec_tpu.device import kernels as dk
+    param("llm_use_pallas", True)
+    # re-arm the lazy seam: an earlier device-tier test may have
+    # promoted the jnp body already, and the loader reads the param at
+    # build time — drop the eager entry so THIS dispatch builds Pallas
+    with dk._lock:
+        dk._kernels.pop(("ragged_attn_page", "tpu"), None)
+    dk.register_lazy_kernel("ragged_attn_page", "tpu", ra._load_page_body)
+    try:
+        prompts = {"a": [3, 7, 11, 5, 9, 2], "b": [1, 40, 8]}
+        steps = {"a": 5, "b": 5}
+        kv, TOK, tp = _superpool_setup(prompts, steps, devices="tpu")
+        with Context(nb_cores=0) as ctx:
+            ctx.add_taskpool(tp)
+            ctx.wait(timeout=240)
+            accel_device.sync()
+        for seq, prompt in prompts.items():
+            want = MODEL.reference_generate(prompt, steps[seq])
+            assert _tokens_of(TOK, seq, steps[seq]) == want, seq
+        assert accel_device.executed_tasks > 0
+    finally:
+        # leave the seam lazy so later consumers rebuild under the
+        # restored llm_use_pallas value
+        with dk._lock:
+            dk._kernels.pop(("ragged_attn_page", "tpu"), None)
+        dk.register_lazy_kernel("ragged_attn_page", "tpu",
+                                ra._load_page_body)
+
+
+# ---------------------------------------------------------------------------
 # continuous batching on the RuntimeServer
 # ---------------------------------------------------------------------------
 
@@ -372,6 +475,155 @@ def test_batcher_direct_on_server_with_custom_kv_geometry():
         b.stop()
 
 
+def test_stream_eos_stops_early_and_matches_truncated_oracle():
+    """EOS sampled mid-superpool (ISSUE 9): the stream finishes at the
+    EOS token (inclusive), the predicated tail is never surfaced, and
+    pages recycle — while a no-EOS stream in the same batch runs to its
+    full budget."""
+    ref = MODEL.reference_generate([3, 7, 11, 5], 10)
+    eos = ref[1]
+    want = MODEL.reference_generate([3, 7, 11, 5], 10, eos=eos)
+    assert 1 <= len(want) < 10       # genuinely mid-superpool (k=8)
+    with RuntimeServer(nb_cores=2) as server:
+        te = server.submit_stream([3, 7, 11, 5], max_new_tokens=10,
+                                  eos=eos)
+        tf = server.submit_stream([1, 40], max_new_tokens=10)
+        re_ = te.result(timeout=120)
+        assert re_["tokens"] == want
+        assert len(re_["per_token_s"]) == len(want)
+        assert tf.result(timeout=120)["tokens"] == \
+            MODEL.reference_generate([1, 40], 10)
+        assert server.stats()["llm"]["kv"]["physical_pages"] == 0
+
+
+def test_streams_join_and_leave_between_superpools(param):
+    """Iteration-level scheduling at superpool grain (k=4): a short
+    stream leaves mid-run, a late one joins at the next superpool
+    boundary — and every stream still matches the oracle token for
+    token."""
+    param("llm_steps_per_pool", 4)
+    with RuntimeServer(nb_cores=2) as server:
+        first = server.submit_stream([3, 7, 11], max_new_tokens=11)
+        short = server.submit_stream([5, 9], max_new_tokens=2)
+        assert short.result(timeout=120)["tokens"] == \
+            MODEL.reference_generate([5, 9], 2)
+        late = server.submit_stream([8, 30], max_new_tokens=6)
+        assert first.result(timeout=120)["tokens"] == \
+            MODEL.reference_generate([3, 7, 11], 11)
+        assert late.result(timeout=120)["tokens"] == \
+            MODEL.reference_generate([8, 30], 6)
+        llm = server.stats()["llm"]
+        assert llm["streams_completed"] == 3
+        # 11 tokens at k=4 is 4+4+3: the superpool clips to the budget
+        assert llm["decode_submits"] < 11 + 2 + 6, llm
+
+
+def test_fork_on_prompt_shares_pages_until_first_divergent_write():
+    """The ISSUE-9 serving surface for PagedKVCollection.fork: streams
+    opened with fork_from= share the parent's prompt pages CoW — full
+    prompt pages stay physically shared for the streams' lifetime, only
+    the tails privatize (at the first divergent write), and every fork
+    still matches the oracle."""
+    with RuntimeServer(nb_cores=2) as server:
+        prompt = list(range(1, 41))    # 39 cached tokens -> 3 pages @16
+        t1 = server.submit_stream(prompt, max_new_tokens=6)
+        t2 = server.submit_stream(prompt, max_new_tokens=4, fork_from=t1)
+        t3 = server.submit_stream(prompt, max_new_tokens=6, fork_from=t1)
+        assert t1.result(timeout=120)["tokens"] == \
+            MODEL.reference_generate(prompt, 6)
+        assert t2.result(timeout=120)["tokens"] == \
+            MODEL.reference_generate(prompt, 4)
+        assert t3.result(timeout=120)["tokens"] == \
+            MODEL.reference_generate(prompt, 6)
+        llm = server.stats()["llm"]
+        assert llm["forked_streams"] == 2
+        kv = llm["kv"]
+        # each fork privatized ONLY its tail page (CoW at the first
+        # divergent write); the full prompt pages were never copied, so
+        # three streams allocated far less than three prompts' worth
+        assert kv["cow_copies"] >= 2, kv
+        prompt_pages = (len(prompt) - 1 + 15) // 16
+        assert kv["pages_allocated"] < 3 * prompt_pages, kv
+        assert kv["physical_pages"] == 0       # everything recycled
+
+
+def test_fork_from_requires_identical_prompt_and_known_ticket():
+    with RuntimeServer(nb_cores=2) as server:
+        t1 = server.submit_stream([1, 2, 3], max_new_tokens=2)
+        with pytest.raises(ValueError, match="identical prompt"):
+            server.submit_stream([1, 2, 4], max_new_tokens=2,
+                                 fork_from=t1)
+        with pytest.raises(ValueError, match="StreamTicket"):
+            server.submit_stream([1, 2, 3], max_new_tokens=2,
+                                 fork_from=object())
+        # a foreign batcher's ticket must be rejected by IDENTITY: its
+        # seq ids collide with ours, so accepting it could fork an
+        # unrelated local sequence's pages
+        with RuntimeServer(nb_cores=1) as other:
+            with pytest.raises(ValueError, match="this batcher"):
+                other.submit_stream([1, 2, 3], max_new_tokens=2,
+                                    fork_from=t1)
+        t1.result(timeout=60)
+
+
+def test_fork_from_retired_parent_falls_back_to_plain_prefill():
+    """A fork whose parent already finished (cache freed) must not fail
+    the child: it silently prefills on its own and still matches the
+    oracle — sharing is an optimization, never a correctness gate."""
+    with RuntimeServer(nb_cores=2) as server:
+        t1 = server.submit_stream([3, 7, 11, 5], max_new_tokens=2)
+        t1.result(timeout=60)          # parent retires, pages freed
+        t2 = server.submit_stream([3, 7, 11, 5], max_new_tokens=3,
+                                 fork_from=t1)
+        assert t2.result(timeout=60)["tokens"] == \
+            MODEL.reference_generate([3, 7, 11, 5], 3)
+        assert server.stats()["llm"]["forked_streams"] == 0
+
+
+def test_fork_from_decoding_parent_falls_back_to_plain_prefill(param):
+    """The classification window: a child can be classified as a fork
+    while its live parent sits exactly at the prompt boundary, and the
+    SAME iteration's decode superpool then advances the parent before
+    the fork resolves.  The child must take the documented silent
+    fallback (its own plain prefill) — never a stream failure from
+    iteration timing."""
+    import time as _time
+    param("llm_steps_per_pool", 2)
+    prompt = [3, 7, 11, 5]
+    with RuntimeServer(nb_cores=2) as server:
+        t1 = server.submit_stream(prompt, max_new_tokens=6)
+        deadline = _time.monotonic() + 60
+        # submit the child while the parent PREFILLS: it lands in the
+        # NEXT iteration's fresh batch, where the parent sits at its
+        # boundary (fork classification) until that iteration's own
+        # decode superpool advances it — the window under test
+        while t1.state == "queued":
+            assert _time.monotonic() < deadline, "parent never admitted"
+            _time.sleep(0.0002)
+        t2 = server.submit_stream(prompt, max_new_tokens=3, fork_from=t1)
+        assert t1.result(timeout=60)["tokens"] == \
+            MODEL.reference_generate(prompt, 6)
+        assert t2.result(timeout=60)["tokens"] == \
+            MODEL.reference_generate(prompt, 3)
+        # the parent was past its boundary by resolve time: sharing is
+        # an optimization, the fallback prefilled the child's own pages
+        assert server.stats()["llm"]["forked_streams"] == 0
+
+
+def test_batcher_region_lowered_superpools_match_oracle(param):
+    """The llm_lower_regions opt-in: the batcher compiles each decode
+    superpool into megakernel regions (PR 8) and submits the REGION
+    pool — tokens must still equal the oracle exactly (the serving-path
+    incarnation of the eager-vs-region equivalence)."""
+    param("llm_lower_regions", True)
+    param("llm_steps_per_pool", 2)
+    with RuntimeServer(nb_cores=2) as server:
+        tk = server.submit_stream([3, 7, 11, 5], max_new_tokens=2)
+        assert tk.result(timeout=240)["tokens"] == \
+            MODEL.reference_generate([3, 7, 11, 5], 2)
+        assert server.stats()["llm"]["kv"]["physical_pages"] == 0
+
+
 def test_step_timeout_defers_page_release_until_pool_terminates():
     """A timed-out step pool may still be RUNNING (serve tickets cannot
     cancel a live DAG): its streams' pages must not recycle to a new
@@ -391,4 +643,30 @@ def test_step_timeout_defers_page_release_until_pool_terminates():
         assert b.stats()["kv"]["physical_pages"] == 1   # ...pages held
         zombie.terminated()
         assert b.stats()["kv"]["physical_pages"] == 0   # released now
+        b.stop()
+
+
+def test_fork_from_zombie_parent_is_never_ready():
+    """A FAILED parent whose page release is deferred behind a
+    timed-out zombie pool still has its seq alive and its host-side
+    ledger exactly at the prompt boundary — but the zombie pool may
+    still be WRITING those pages.  ``_fork_ready`` must refuse it (the
+    child then takes the plain-prefill fallback) rather than CoW-share
+    pages mid-write."""
+    from parsec_tpu.llm.batcher import StreamTicket, _Stream
+    from parsec_tpu.runtime.taskpool import Taskpool
+    with RuntimeServer(nb_cores=1) as server:
+        b = ContinuousBatcher(server, model=MODEL, kv=_kv())
+        prompt = [3, 7, 11, 5]
+        b.kv.alloc_seq("p")
+        prefill_chunks(MODEL, b.kv, "p", prompt[:-1])
+        st = _Stream("p", "t", 0, prompt, 4, StreamTicket("p", "t"))
+        assert b._fork_ready(st)         # live parent at its boundary
+        zombie = Taskpool(name="zombie_step")
+        b._retire_failed([st], TimeoutError("step timeout"),
+                         defer_pool=zombie)
+        # the ledger alone cannot tell this apart from a healthy parent
+        assert b.kv.seq_len("p") == len(prompt) - 1
+        assert not b._fork_ready(st)     # retired: never fork it
+        zombie.terminated()
         b.stop()

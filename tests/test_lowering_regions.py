@@ -388,6 +388,77 @@ def test_region_llm_decode_pool_passes_graphcheck():
 
 
 # ---------------------------------------------------------------------------
+# LLM k-step decode superpool: the ISSUE-9 multi-step generalization
+# ---------------------------------------------------------------------------
+
+def _superpool_setup(steps, devices):
+    """k-step geometry over PROMPTS, prepped by the library's own
+    ``seed_decode_superpool`` (the batcher's seeding contract)."""
+    from parsec_tpu.llm import decode_superpool_ptg, seed_decode_superpool
+    kv = PagedKVCollection("KV", page_size=4, num_heads=H, head_dim=D)
+    Q = DictCollection("Q", dtt=TileType((3, H, D), np.float32))
+    O = DictCollection("O", dtt=TileType((H, D), np.float32))
+    TOK = DictCollection("TOK", dtt=TileType((3,), np.float32))
+    EMB = DictCollection("EMB", dtt=TileType(MODEL.q3_table().shape,
+                                             np.float32))
+    seed_decode_superpool(MODEL, kv, Q, TOK, EMB, PROMPTS, steps)
+    tp = decode_superpool_ptg(kv, Q, O, TOK, EMB, list(PROMPTS),
+                              [steps[s] for s in PROMPTS],
+                              devices=devices)
+    return kv, TOK, tp
+
+
+@pytest.mark.parametrize("max_tasks", [0, 8])
+def test_region_llm_superpool_k_steps_matches_eager_runtime(max_tasks):
+    """The ISSUE-9 acceptance: the 1-step eager-vs-region equivalence
+    generalizes to k > 1 — cross-step tail-page dataflow, in-graph
+    SAMPLE chains, mixed per-seq step counts, page boundaries crossed
+    mid-pool — and both paths equal the dense token oracle."""
+    steps = {"a": 5, "b": 4, "c": 2}
+    kv_e, TOK_e, tp_e = _superpool_setup(steps, "cpu")
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp_e)
+        ctx.wait(timeout=120)
+
+    kv_r, TOK_r, tp_r = _superpool_setup(steps, "auto")
+    plan = lower_regions(tp_r, max_tasks=max_tasks)
+    if max_tasks == 0:
+        # per-sequence chains stay independent components across steps
+        assert len(plan.regions) == len(PROMPTS)
+    plan.execute()
+
+    from parsec_tpu.llm import read_token_chain
+
+    def toks(TOK, seq, k):
+        return read_token_chain(TOK, seq, k)[0]
+
+    for seq, prompt in PROMPTS.items():
+        want = MODEL.reference_generate(prompt, steps[seq])
+        assert toks(TOK_e, seq, steps[seq]) == want, ("eager", seq)
+        assert toks(TOK_r, seq, steps[seq]) == want, ("region", seq)
+        # the tail page (appended k/v of every step) must agree too
+        pe = np.asarray(
+            kv_e.data_of(seq, kv_e.npages(seq) - 1).newest_copy().value)
+        pr = np.asarray(
+            kv_r.data_of(seq, kv_r.npages(seq) - 1).newest_copy().value)
+        np.testing.assert_allclose(pr, pe, rtol=1e-5, atol=1e-6)
+
+
+def test_region_llm_superpool_pool_passes_graphcheck():
+    """The region pool built from a k-step superpool is itself a clean
+    PTG pool (region scheduling must not hide the cross-step WAR/WAW
+    hazards the whole-pool analysis proved ordered)."""
+    steps = {"a": 4, "b": 3, "c": 2}
+    _kv, _TOK, tp = _superpool_setup(steps, "auto")
+    plan = lower_regions(tp)
+    plan.compile()
+    table = plan.materialize_table()
+    pool = plan.taskpool(table)
+    report = pool.validate()
+    assert not report.errors, report.summary()
+
+
+# ---------------------------------------------------------------------------
 # graphcheck gating: an unverifiable pool never region-lowers
 # ---------------------------------------------------------------------------
 
@@ -421,6 +492,20 @@ def test_warm_cache_cli_region_mode(capsys):
     assert out["region"]["regions"] >= 1
     assert out["region"]["regions_eager"] == 0
     assert "backend" in out                   # the cross-backend cache key
+
+
+def test_warm_cache_cli_llm_decode_k_workload(capsys):
+    """The ISSUE-9 AOT entry: the k-step decode superpool's region
+    programs warm through the CLI (scripts/warm_cache.sh ships it in
+    the default workload set)."""
+    rc = lowering._main(["--warm", "llm_decode_k", "--n", "2", "--nb",
+                         "2", "--modes", "region"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["workload"] == "llm_decode_k"
+    assert out["nseqs"] == 2 and out["steps"] == 2
+    assert out["region"]["regions"] >= 1
+    assert out["region"]["regions_eager"] == 0
 
 
 def test_warm_cache_traces_against_avals_without_executing():

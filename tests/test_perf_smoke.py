@@ -48,9 +48,17 @@ COMM_GET_SPEEDUP_VS_PICKLE_MIN = 1.5
 COMM_OVERLAP_EFFICIENCY_MIN = 0.02
 # ISSUE-6 LLM serving baseline: ~450 tokens/s at 1 stream, ~1300 at 4
 # (continuous batching over paged-KV decode pools, 2 CPU workers),
-# per-token p50 ~1-2.5ms / p99 ~4ms — same ~10x headroom discipline
-LLM_TOKENS_PER_S_MIN = 100.0
+# per-token p50 ~1-2.5ms / p99 ~4ms.  ISSUE 9 (k-step decode superpools,
+# in-graph SAMPLE) multiplied the 4-stream smoke point several-fold, so
+# the gate is raised to lock in AT LEAST 2x the PR-6 line (its old gate
+# was 100 with ~10x headroom): a regression that quietly re-enters the
+# host loop per token fails here by name
+LLM_TOKENS_PER_S_MIN = 250.0
 LLM_P99_MS_MAX = 250.0
+# the amortization itself is gated too: k=8 superpools vs k=1 in the
+# SAME run must keep a real multiple (measured ~3-6x on 4 streams; the
+# ISSUE-9 acceptance line is >= 3x at 8 streams in the full bench)
+LLM_SUPERPOOL_SPEEDUP_MIN = 1.8
 
 
 def test_compiled_dispatch_latency():
@@ -124,17 +132,28 @@ def test_comm_overlap_efficiency_threshold(comm_numbers):
 
 
 def test_llm_decode_throughput_and_latency():
-    """The LLM serving path (ISSUE 6): continuous batching over paged-KV
-    decode pools on a hot RuntimeServer must sustain tokens/s with
-    bounded per-token p99 — tier-1's guard on the decode critical path
-    (admission + WFQ + live enqueue + ragged ATTN chains)."""
+    """The LLM serving path (ISSUE 6 + 9): k-step decode superpools over
+    the paged KV cache on a hot RuntimeServer must sustain tokens/s with
+    bounded per-token p99, and the superpool amortization (one submit
+    per k tokens, in-graph SAMPLE) must hold against the k=1 baseline
+    measured in the same run — tier-1's guard on the decode critical
+    path (admission + WFQ + live enqueue + ragged ATTN chains)."""
     r = microbench.bench_llm(smoke=True)
     assert r["llm_tokens_per_s"] >= LLM_TOKENS_PER_S_MIN, r
     assert r["llm_p99_ms"] <= LLM_P99_MS_MAX, r
-    # the sweep axis is really swept: both points present and sane
+    # the sweep axes are really swept: all points present and sane
     sweep = r["llm_streams_sweep"]
     assert set(sweep) == {"1", "4"}, r
     assert all(v["tokens_per_s"] > 0 for v in sweep.values()), r
+    ksweep = r["llm_steps_sweep"]
+    assert set(ksweep) == {"1", "8"}, r
+    assert r["llm_superpool_speedup"] >= LLM_SUPERPOOL_SPEEDUP_MIN, r
+    # the amortization claim is structural, not just a timing: k=8
+    # superpools must submit at most ~1/8 pool per token (one pool can
+    # carry a whole tenant batch, so strictly fewer still passes)
+    assert ksweep["8"]["submits_per_token"] <= 1.0 / 8 + 1e-9, r
+    assert ksweep["1"]["submits_per_token"] > ksweep["8"][
+        "submits_per_token"], r
 
 
 def test_lowering_cache_warm_compile_is_near_zero():
