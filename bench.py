@@ -351,11 +351,25 @@ def _note_partial(**kw) -> None:
     its deadline — historically in XLA compile (BENCH_r04/r05, rc 124) —
     the degrade record carries whatever landed here instead of losing
     the stage entirely, and ``phase == "compile"`` at timeout turns the
-    record into a ``{"status": "compile_timeout"}`` entry."""
+    record into a ``{"status": "compile_timeout"}`` entry.
+
+    Every flush also snapshots the live SLO histogram planes
+    (serialized bucket arrays, ``prof/histogram.serialized_planes``): a
+    deadline death mid-serve/llm stage keeps the latency DISTRIBUTION
+    collected so far — reconstructable with ``LogHistogram.from_dict``
+    — not just the counters."""
     import threading
     name = threading.current_thread().name
     if name.startswith("bench-"):
-        _stage_partials.setdefault(name[len("bench-"):], {}).update(kw)
+        d = _stage_partials.setdefault(name[len("bench-"):], {})
+        d.update(kw)
+        try:
+            from parsec_tpu.prof.histogram import serialized_planes
+            s = serialized_planes()
+            if s:
+                d["slo_hist"] = s
+        except Exception:       # noqa: BLE001 — partials must never raise
+            pass
 
 
 def _time_lowered(low, sync_store: str, reps: int = 3):

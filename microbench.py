@@ -17,6 +17,9 @@ measurable even when the TPU relay is dark:
   sharded per-stream deques (sched/modules.py);
 - ``bench_pins_disabled_ns``   — cost of one DISABLED instrumentation site
   (the per-event dispatch-slot fast path, prof/pins.py);
+- ``bench_tracing``            — request-tracing costs (prof/spans.py +
+  prof/histogram.py): span record ns, SLO histogram record ns, and the
+  enabled-vs-disabled dynamic dispatch delta (the ≤1µs/task budget);
 - ``bench_lowering_cache``     — first-vs-second compile seconds of an
   identical lowered taskpool (the persistent lowering cache,
   ptg/lowering.py);
@@ -67,13 +70,18 @@ def _ep_pool(NT: int, DEPTH: int):
     return p
 
 
-def _drain_ep_us(ntasks: int, reps: int, compiled: bool) -> tuple:
+def _drain_ep_us(ntasks: int, reps: int, compiled: bool,
+                 traced: bool = False) -> tuple:
     """Median enqueue-to-drain wall time per task in µs, plus whether the
     compiled-DAG executor actually engaged (it silently declines when the
     native extension is unavailable — the reading must say which path it
-    measured, or the dispatch trend mixes incomparable series)."""
+    measured, or the dispatch trend mixes incomparable series).
+    ``traced=True`` attaches a trace context to every pool, so an
+    INSTALLED span recorder actually records (the enabled-cost axis of
+    ``bench_tracing``)."""
     import parsec_tpu.runtime.dagrun  # noqa: F401 — runtime_dag_compile
     from parsec_tpu.core.params import params
+    from parsec_tpu.prof import spans
     from parsec_tpu.runtime import Context
 
     NT = 50
@@ -86,6 +94,8 @@ def _drain_ep_us(ntasks: int, reps: int, compiled: bool) -> tuple:
         times = []
         for _ in range(reps):
             tp = builder.build()
+            if traced:
+                tp._trace = spans.new_trace()
             ctx = Context(nb_cores=0)
             t0 = time.perf_counter()
             ctx.add_taskpool(tp)
@@ -189,6 +199,61 @@ def bench_pins_disabled_ns(iters: int = 200000) -> dict:
            if disabled is not None else None}
     if hooks[ev] is not None:       # always-on recorder (or chains) present
         out["pins_enabled_ns"] = round(run(), 2)
+    return out
+
+
+def bench_tracing(ntasks: int = 2000, reps: int = 3,
+                  smoke: bool = False) -> dict:
+    """The request-tracing cost axes (prof/spans.py, prof/histogram.py):
+
+    - ``span_record_ns``     — one finished-span record (tuple + append,
+      the ring-write-shaped enabled cost);
+    - ``hist_record_ns``     — one SLO histogram sample (one log, one
+      bucket increment);
+    - ``tracing_dispatch_off_us`` / ``_on_us`` / ``_delta_us`` — dynamic
+      per-task dispatch with the recorder UNINSTALLED (the shipped
+      default: the PINS table's one-branch cost, nothing more) vs
+      INSTALLED with every pool traced.  The acceptance budget: disabled
+      within 10% of the PR-2 overhead baseline, enabled ≤1µs/task
+      (both gated with headroom in tests/test_perf_smoke.py)."""
+    from parsec_tpu.prof import spans
+    from parsec_tpu.prof.histogram import LogHistogram
+
+    if smoke:
+        ntasks, reps = 1000, 2
+    out: dict = {}
+    # -- span record cost (a throwaway recorder; never installed) ------
+    rec = spans.SpanRecorder(1 << 20)
+    tr = spans.new_trace()
+    n = 20000
+    t0 = time.perf_counter()
+    for _i in range(n):
+        rec.record("exec", tr.trace_id, 0, 100)
+    out["span_record_ns"] = round(
+        (time.perf_counter() - t0) / n * 1e9, 1)
+    # -- histogram record cost -----------------------------------------
+    h = LogHistogram()
+    t0 = time.perf_counter()
+    for _i in range(n):
+        h.record(1.234)
+    out["hist_record_ns"] = round(
+        (time.perf_counter() - t0) / n * 1e9, 1)
+    # -- enabled-vs-disabled dynamic dispatch --------------------------
+    prev = spans.recorder      # a user-installed recorder (and its
+    if prev is not None:       # accumulated spans) must survive this
+        spans.uninstall()      # measurement — restored object-identical
+    off, _ = _drain_ep_us(ntasks, reps, compiled=False)
+    spans.install()
+    try:
+        on, _ = _drain_ep_us(ntasks, reps, compiled=False, traced=True)
+        out["tracing_spans_recorded"] = len(spans.recorder.spans)
+    finally:
+        spans.uninstall()
+        if prev is not None:
+            spans.install(recorder_obj=prev)
+    out["tracing_dispatch_off_us"] = round(off, 3)
+    out["tracing_dispatch_on_us"] = round(on, 3)
+    out["tracing_dispatch_delta_us"] = round(on - off, 3)
     return out
 
 
@@ -369,6 +434,11 @@ def bench_serve(nsub: int = 64, nthreads: int = 4, depth: int = 8,
     for th in threads:
         th.join()
     wall = time.perf_counter() - t0
+    # the per-tenant SLO plane, read LIVE off the still-hot server
+    # (RuntimeServer.metrics(), the histogram plane): queue wait +
+    # end-to-end latency quantiles per tenant, before drain resets
+    # anything — the mid-run acceptance read
+    slo = server.metrics()["tenants"]
     server.drain(timeout=60)
     if errors:
         raise errors[0]
@@ -381,6 +451,8 @@ def bench_serve(nsub: int = 64, nthreads: int = 4, depth: int = 8,
         "serve_nsub": n,
         "serve_threads": nthreads,
         "serve_tasks_per_sub": 4 * depth,
+        "serve_slo": slo,
+        "serve_drain_s": round(server.metrics()["drain_s"] or 0.0, 4),
     }
 
 
@@ -473,6 +545,15 @@ def bench_llm(streams_sweep: tuple = (1, 4, 8),
         out["llm_new_tokens"] = new_tokens
         out["llm_prompt_len"] = prompt_len
         out["llm_kv"] = server.stats()["llm"]["kv"]
+        # per-tenant TTFT + inter-token latency quantiles off the SLO
+        # histogram plane, read LIVE (RuntimeServer.metrics()) while the
+        # server is still hot — the same numbers mid-run and in the emit
+        out["llm_slo"] = {
+            tenant: {k: v for k, v in d.items()
+                     if k.startswith(("ttft_ms", "tok_latency_ms",
+                                      "queue_wait_ms"))}
+            for tenant, d in server.metrics()["tenants"].items()
+            if "ttft_ms_p50" in d}
     finally:
         _params.set("llm_steps_per_pool", saved_k)
         server.drain(timeout=60)
@@ -707,6 +788,7 @@ def run_all(smoke: bool = False, include_lowering: bool = True,
     out.update(bench_release_throughput(ntasks, max(reps - 2, 1)))
     out.update(bench_steal_us())
     out.update(bench_pins_disabled_ns(50000 if smoke else 200000))
+    out.update(bench_tracing(smoke=smoke))
     if include_serve:
         out.update(bench_serve(nsub=16 if smoke else 64,
                                depth=4 if smoke else 8))

@@ -37,14 +37,17 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from collections import deque
 from typing import Any, Callable
 
 import numpy as np
 
 from ..core.params import params as _params
-from ..prof import pins
+from ..prof import pins, spans as _spans
 from ..prof.pins import PinsEvent
+
+_now_ns = time.perf_counter_ns
 
 # Reserved AM tags (cf. parsec_comm_engine.h:24-40).
 AM_TAG_GET_REQ = 1       # internal: rendezvous pull request
@@ -113,16 +116,20 @@ class _FragSend:
     """Sender-side state of one fragmented rendezvous reply: the ordered
     piece list plus the send cursor the credit window advances."""
 
-    __slots__ = ("dst", "get_id", "handle_id", "pieces", "meta", "next")
+    __slots__ = ("dst", "get_id", "handle_id", "pieces", "meta", "next",
+                 "trace", "t0")
 
     def __init__(self, dst: int, get_id: int, handle_id: int,
-                 pieces: list, meta: dict) -> None:
+                 pieces: list, meta: dict, trace: int = 0,
+                 t0: int = 0) -> None:
         self.dst = dst
         self.get_id = get_id
         self.handle_id = handle_id
         self.pieces = pieces        # [(byte_offset, nbytes, buffer), ...]
         self.meta = meta
         self.next = 0
+        self.trace = trace          # 8-byte trace context (prof/spans.py)
+        self.t0 = t0                # serve-span open timestamp (ns)
 
 
 class _LandingZone:
@@ -158,7 +165,11 @@ class InprocFabric:
         self.engines[rank] = eng
         return eng
 
-    def deliver(self, dst: int, tag: int, src: int, payload: Any) -> None:
+    def deliver(self, dst: int, tag: int, src: int, payload: Any,
+                trace_id: int = 0) -> None:
+        # trace_id is a wire-header concern (socket_fabric packs it into
+        # the CTRL header's u2 word); the in-process fabric has no frame
+        # headers, and the payload-level trace fields already carry it
         with self._locks[dst]:
             self._inboxes[dst].append((tag, src, payload))
 
@@ -196,7 +207,11 @@ class CommEngine:
         """``cb(engine, src_rank, payload)`` runs during ``progress``."""
         self._am_callbacks[tag] = cb
 
-    def send_am(self, tag: int, dst: int, payload: Any) -> None:
+    def send_am(self, tag: int, dst: int, payload: Any,
+                trace_id: int = 0) -> None:
+        """``trace_id`` (optional 8-byte trace context, prof/spans.py)
+        rides the frame header on binary-framed transports — payload
+        semantics are untouched."""
         raise NotImplementedError
 
     # -- registered memory / one-sided ---------------------------------------
@@ -264,9 +279,13 @@ class CommEngine:
         return len(drained)
 
     def get(self, rwire: tuple[int, int],
-            on_complete: Callable[[Any], None]) -> None:
+            on_complete: Callable[[Any], None],
+            trace: int | None = None) -> None:
         """One-sided pull of the remote buffer named by ``rwire``;
-        ``on_complete(value)`` runs locally when the payload has landed."""
+        ``on_complete(value)`` runs locally when the payload has landed.
+        ``trace`` is an optional 8-byte trace id (prof/spans.py): it
+        rides the GET request so BOTH ends span-record the transfer
+        under the originating request's trace."""
         raise NotImplementedError
 
     # -- lifecycle / progress -------------------------------------------------
@@ -316,6 +335,10 @@ class InprocCommEngine(CommEngine):
         self._frag_sends: dict[tuple[int, int], _FragSend] = {}
         self._frag_lock = threading.Lock()
         self._frag_active = 0
+        # requester-side span state by get_id: (trace_id, t0_ns) —
+        # entries exist only while the span recorder is installed, so
+        # the disabled path never touches the dict
+        self._get_spans: dict[int, tuple[int, int]] = {}
         self.frags_in = 0
         self.frag_bytes_in = 0
         self.frags_out = 0
@@ -328,36 +351,58 @@ class InprocCommEngine(CommEngine):
         self.tag_register(AM_TAG_BARRIER, self._on_barrier)
 
     # -- AM -------------------------------------------------------------------
-    def send_am(self, tag: int, dst: int, payload: Any) -> None:
+    def send_am(self, tag: int, dst: int, payload: Any,
+                trace_id: int = 0) -> None:
         # self-sends also go through the inbox so the callback runs from
         # progress(), never from the sender's stack
-        self.fabric.deliver(dst, tag, self.rank, payload)
+        self.fabric.deliver(dst, tag, self.rank, payload,
+                            trace_id=trace_id)
 
     # -- one-sided get: rendezvous through internal AMs ----------------------
     # (the same emulation the reference's MPI backend uses: GET req AM →
     #  source replies with the payload, parsec_mpi_funnelled.c:247,980)
     def get(self, rwire: tuple[int, int],
-            on_complete: Callable[[Any], None]) -> None:
+            on_complete: Callable[[Any], None],
+            trace: int | None = None) -> None:
         owner, handle_id = rwire
         get_id = next(self._get_ids)
         self._pending_gets[get_id] = on_complete
-        self.send_am(AM_TAG_GET_REQ, owner,
-                     {"handle": handle_id, "get_id": get_id,
-                      "reply_to": self.rank})
+        msg = {"handle": handle_id, "get_id": get_id,
+               "reply_to": self.rank}
+        if _spans.recorder is not None:
+            self._get_spans[get_id] = (trace or 0, _now_ns())
+        if trace:
+            msg["trace"] = trace
+        self.send_am(AM_TAG_GET_REQ, owner, msg, trace_id=trace or 0)
+
+    def _record_get_span(self, get_id: int, nbytes: int) -> None:
+        """Requester-side "comm.get" span: request sent -> payload
+        landed, flow-keyed ``get:<requester>:<get_id>`` so tracemerge
+        stitches it against the producer's serve span."""
+        ent = self._get_spans.pop(get_id, None)
+        r = _spans.recorder
+        if ent is None or r is None:
+            return
+        tr, t0 = ent
+        r.record("comm.get", tr, t0, _now_ns(),
+                 args={"flow": f"get:{self.rank}:{get_id}",
+                       "flow_side": "recv", "bytes": nbytes})
 
     def _serve_get(self, eng: CommEngine, src: int, msg: dict) -> None:
         h = self.mem_retrieve(msg["handle"])
         if h is None:
             raise RuntimeError(
                 f"rank {self.rank}: GET for unknown handle {msg['handle']}")
+        t0 = _now_ns() if _spans.recorder is not None else 0
         value = self._serve_value(h)
         plan = self._plan_frags(value)
+        trace = msg.get("trace") or 0
         if plan is not None:
             # large payload: windowed fragmented reply — the receiver
             # copies fragments into its own preallocated destination, so
             # no sender-side ownership copy is needed here
             self._start_frag_send(msg["reply_to"], msg["get_id"],
-                                  msg["handle"], plan)
+                                  msg["handle"], plan, trace=trace, t0=t0)
             return
         # the DMA copy: the receiver must own its bytes (ICI read analog).
         # The registered buffer is already a private snapshot, so the LAST
@@ -365,7 +410,15 @@ class InprocCommEngine(CommEngine):
         if isinstance(value, np.ndarray) and h.refcount > 1:
             value = value.copy()
         self.send_am(AM_TAG_GET_REPLY, msg["reply_to"],
-                     {"get_id": msg["get_id"], "value": value})
+                     {"get_id": msg["get_id"], "value": value},
+                     trace_id=trace)
+        r = _spans.recorder
+        if r is not None:
+            r.record("comm.get_serve", trace, t0, _now_ns(),
+                     args={"flow": f"get:{msg['reply_to']}:"
+                                   f"{msg['get_id']}",
+                           "flow_side": "emit",
+                           "bytes": int(getattr(value, "nbytes", 0))})
         # the puller's share is consumed: clear it from the expected-peer
         # set too, so a LATER death of that rank cannot double-release
         self.mem_release(msg["handle"], peer=msg["reply_to"])
@@ -377,7 +430,10 @@ class InprocCommEngine(CommEngine):
             # reconnect): the first landing completed the get — idempotent
             self.dup_get_replies += 1
             return
-        cb(self._land_value(msg["value"]))
+        value = self._land_value(msg["value"])
+        self._record_get_span(msg["get_id"],
+                              int(getattr(value, "nbytes", 0)))
+        cb(value)
 
     # -- fragmentation hooks (overridden by the device tiers) -----------------
     def _serve_value(self, h: MemHandle) -> Any:
@@ -417,9 +473,14 @@ class InprocCommEngine(CommEngine):
 
     # -- fragmentation: sender side -------------------------------------------
     def _start_frag_send(self, dst: int, get_id: int, handle_id: int,
-                         plan: tuple[list, dict]) -> None:
+                         plan: tuple[list, dict], trace: int = 0,
+                         t0: int = 0) -> None:
         pieces, meta = plan
-        fs = _FragSend(dst, get_id, handle_id, pieces, meta)
+        if trace:
+            # the first DATA frame's codec meta carries the trace: later
+            # fragments resolve through their get_id (docs/OBSERVABILITY)
+            meta = dict(meta, trace=trace)
+        fs = _FragSend(dst, get_id, handle_id, pieces, meta, trace, t0)
         with self._frag_lock:
             self._frag_sends[(dst, get_id)] = fs
             self._frag_active += 1
@@ -443,6 +504,14 @@ class InprocCommEngine(CommEngine):
             with self._frag_lock:
                 self._frag_sends.pop((fs.dst, fs.get_id), None)
                 self._frag_active -= 1
+            r = _spans.recorder
+            if r is not None:
+                r.record("comm.get_serve", fs.trace, fs.t0 or _now_ns(),
+                         _now_ns(),
+                         args={"flow": f"get:{fs.dst}:{fs.get_id}",
+                               "flow_side": "emit",
+                               "bytes": int(fs.meta.get("nbytes", 0)),
+                               "frags": len(fs.pieces)})
             self.mem_release(fs.handle_id, peer=fs.dst)
         return True
 
@@ -542,6 +611,7 @@ class InprocCommEngine(CommEngine):
             self._frag_active -= 1
         value = self._land_value(self._zone_finish(zone))
         pins.fire(PinsEvent.COMM_GET_DONE, None, int(zone.meta["nbytes"]))
+        self._record_get_span(get_id, int(zone.meta["nbytes"]))
         cb = self._pending_gets.pop(get_id, None)
         if cb is None:
             self.dup_get_replies += 1

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Any
 
 import numpy as np
@@ -43,8 +44,10 @@ import numpy as np
 from ..core.params import params as _params
 from ..data.data import data_create
 from ..data.datatype import wire_slice_key
-from ..prof import pins
+from ..prof import pins, spans as _spans
 from ..prof.pins import PinsEvent
+
+_now_ns = time.perf_counter_ns
 from ..runtime.scheduling import (ExecutionStream, _find_input_dep,
                                   apply_writeback_to_home, schedule_tasks)
 from ..runtime.task import Task
@@ -134,16 +137,21 @@ def _unpack_desc(t: tuple) -> dict:
 
 
 def pack_activation(msg: dict) -> tuple:
-    """dict activation → positional wire tuple (tag "A")."""
+    """dict activation → positional wire tuple (tag "A").  The trailing
+    element is the request's 8-byte trace context (prof/spans.py; 0 =
+    untraced) — the cross-rank propagation of request-scoped tracing."""
     return ("A", msg["tp"], msg["tc"], msg["locals"],
             [_pack_desc(d) for d in msg["outputs"]], msg["ranks"],
-            msg["tree"], msg["priority"], msg["seq"], msg["pos"])
+            msg["tree"], msg["priority"], msg["seq"], msg["pos"],
+            msg.get("trace") or 0)
 
 
 def unpack_activation(t: tuple) -> dict:
     return {"tp": t[1], "tc": t[2], "locals": t[3],
             "outputs": [_unpack_desc(x) for x in t[4]], "ranks": t[5],
-            "tree": t[6], "priority": t[7], "seq": t[8], "pos": t[9]}
+            "tree": t[6], "priority": t[7], "seq": t[8], "pos": t[9],
+            # mixed-version peers may still ship the 10-element form
+            "trace": t[10] if len(t) > 10 else 0}
 
 
 # ---------------------------------------------------------------------------
@@ -151,6 +159,14 @@ def unpack_activation(t: tuple) -> dict:
 # the sorted participant list, position 0 = root; children are re-derived
 # identically at every hop, so no child list rides the wire
 # ---------------------------------------------------------------------------
+
+def _packed_trace(m: Any) -> int:
+    """The trace id of one staged activation (packed tuple element 10;
+    0 for legacy/test payloads that never carried one)."""
+    if type(m) is tuple and len(m) > 10 and type(m[10]) is int:
+        return m[10]
+    return 0
+
 
 def tree_children(kind: str, position: int, n: int) -> list[int]:
     if n <= 1:
@@ -378,7 +394,8 @@ class RemoteDepEngine:
         # dicts (tests driving the staging queue directly) pass through
         packed = pack_activation(msg) if "tp" in msg else msg
         if not _params.get("comm_coalesce"):
-            self.ce.send_am(AM_TAG_ACTIVATE, dst, packed)
+            self.ce.send_am(AM_TAG_ACTIVATE, dst, packed,
+                            trace_id=_packed_trace(packed))
             return
         with self._outq_lock:
             self._outq.setdefault(dst, []).append(
@@ -407,7 +424,12 @@ class RemoteDepEngine:
                 items.sort(key=lambda it: it[:2])
                 msgs = [m for _, _, m in items]
                 if len(msgs) == 1:
-                    self.ce.send_am(AM_TAG_ACTIVATE, dst, msgs[0])
+                    # a lone activation's trace context rides the frame
+                    # header too (CTRL u2); coalesced aggregates mix
+                    # requests, so their header word stays 0 and the
+                    # per-message trace fields carry it instead
+                    self.ce.send_am(AM_TAG_ACTIVATE, dst, msgs[0],
+                                    trace_id=_packed_trace(msgs[0]))
                 else:
                     # coalesced same-peer aggregate: a flat positional
                     # batch, no nested per-message dicts on the wire
@@ -524,6 +546,7 @@ class RemoteDepEngine:
                         desc["shape"] = value.shape
                         desc["dtype"] = str(value.dtype)
                 outputs.append(desc)
+            tr = getattr(tp, "_trace", None)
             msg = {
                 "tp": tp.comm_id,
                 "tc": task.task_class.task_class_id,
@@ -534,6 +557,9 @@ class RemoteDepEngine:
                 "ranks": [self.my_rank] + ranks,
                 "tree": _params.get("comm_bcast_tree"),
                 "priority": task.priority,
+                # the request's 8-byte trace context rides every hop of
+                # the propagation tree (prof/spans.py; 0 = untraced)
+                "trace": tr.trace_id if tr is not None else 0,
             }
             self._send_to_children(tp, msg, my_pos=0)
         self._flush_if_unthreaded()
@@ -553,6 +579,15 @@ class RemoteDepEngine:
             child_msg["pos"] = child_pos
             pins.fire(PinsEvent.COMM_ACTIVATE_SEND, None,
                       (ranks[child_pos], seq))
+            r = _spans.recorder
+            if r is not None and msg.get("trace"):
+                # the emit half of one activation hop: tracemerge
+                # stitches it to the child rank's recv span by flow id
+                t = _now_ns()
+                r.record("comm.activate", msg["trace"], t, t,
+                         args={"flow": f"act:{self.my_rank}:{seq}",
+                               "flow_side": "emit",
+                               "dst": ranks[child_pos]})
             self._post_activate(ranks[child_pos], child_msg)
 
     def _on_ack(self, eng, src: int, msg: dict) -> None:
@@ -683,12 +718,16 @@ class RemoteDepEngine:
             return cb
 
         for d in want:
-            self.ce.get(tuple(d["wire"]), make_cb(d))
+            # the GET inherits the activation's trace context, so both
+            # ends of the rendezvous span-record under the request
+            self.ce.get(tuple(d["wire"]), make_cb(d),
+                        trace=msg.get("trace") or None)
 
     def _complete_incoming(self, tp: Any, src: int, msg: dict,
                            landed: dict[int, Any]) -> None:
         """All payloads present: release local successors, apply writebacks,
         forward down the tree, ack the parent."""
+        t0 = _now_ns() if _spans.recorder is not None else 0
         for v in landed.values():
             self.payload_bytes_received += int(getattr(v, "nbytes", 0))
         tp.tdm.on_comm_recv()
@@ -773,6 +812,15 @@ class RemoteDepEngine:
 
         self.ce.send_am(AM_TAG_GET_ACK, src, {"seq": msg["seq"]})
         pins.fire(PinsEvent.ACTIVATE_CB_END, None, (src, msg["seq"]))
+        r = _spans.recorder
+        if r is not None and msg.get("trace"):
+            # the recv half of the activation hop: flow-keyed by the
+            # SENDING rank + seq, matching the emitter's span
+            r.record("comm.activate", msg["trace"], t0 or _now_ns(),
+                     _now_ns(),
+                     args={"flow": f"act:{src}:{msg['seq']}",
+                           "flow_side": "recv",
+                           "released": len(ready)})
         if ready:
             schedule_tasks(self._es, ready, 0)
 

@@ -13,7 +13,10 @@ Wire format (``comm_wire_binary``, the default): every frame is a fixed
 u2) followed by a kind-specific body:
 
 - ``CTRL`` — an active message.  u0 = meta length, u1 = total raw-segment
-  bytes.  Body = codec meta blob + raw buffer segments (ndarray bodies),
+  bytes, u2 = the 8-byte **trace context** of the request the message
+  belongs to (0 = untraced; ``prof/spans.py`` — the receive thread
+  span-records traced frames, so a request's wire hops appear in its
+  trace).  Body = codec meta blob + raw buffer segments (ndarray bodies),
   sent with ``socket.sendmsg`` scatter-gather straight from the payload's
   own buffers and received with ``recv_into`` straight into freshly
   allocated final buffers (:mod:`parsec_tpu.comm.codec`) — no pickling of
@@ -59,6 +62,7 @@ from typing import Any
 
 from ..core.params import params as _params
 from ..data.arena import wire_pool
+from ..prof import spans as _spans
 from . import codec
 from .engine import AM_TAG_GET_FRAG, InprocCommEngine
 
@@ -364,7 +368,8 @@ class SocketFabric:
                     self._prune_unacked(src, seq)
                     continue
                 if kind == K_CTRL:
-                    self._recv_ctrl(conn, tag, src, seq, u0, u1, ack_every)
+                    self._recv_ctrl(conn, tag, src, seq, u0, u1, ack_every,
+                                    trace_id=u2)
                 elif kind == K_DATA:
                     self._recv_data(conn, flags, src, seq, u0, u1, u2,
                                     ack_every)
@@ -396,7 +401,10 @@ class SocketFabric:
             rx[2] += 1
 
     def _recv_ctrl(self, conn: socket.socket, tag: int, src: int, seq: int,
-                   meta_len: int, seg_bytes: int, ack_every: int) -> None:
+                   meta_len: int, seg_bytes: int, ack_every: int,
+                   trace_id: int = 0) -> None:
+        t0 = time.perf_counter_ns() if trace_id \
+            and _spans.recorder is not None else 0
         meta = wire_pool.acquire(meta_len)
         try:
             if not _recv_exact_into(conn, meta):
@@ -411,6 +419,15 @@ class SocketFabric:
             payload = codec.decode(meta, fill)
         finally:
             wire_pool.release(meta)
+        if t0:
+            # a traced CTRL frame landing: the wire-level receive span
+            # (header trace word u2), attributed to the request's trace
+            r = _spans.recorder
+            if r is not None:
+                r.record("wire.ctrl", trace_id, t0,
+                         time.perf_counter_ns(),
+                         args={"src": src,
+                               "bytes": _HDR.size + meta_len + seg_bytes})
         ack_now = None
         with self._ilock:
             self._rx_account(src, _HDR.size + meta_len + seg_bytes, False)
@@ -570,7 +587,8 @@ class SocketFabric:
             except Exception:       # a GC hook must never mask the OSError
                 pass
 
-    def deliver(self, dst: int, tag: int, src: int, payload: Any) -> None:
+    def deliver(self, dst: int, tag: int, src: int, payload: Any,
+                trace_id: int = 0) -> None:
         if dst == self.rank:
             with self._ilock:
                 self._inbox.append((tag, src, payload))
@@ -580,10 +598,11 @@ class SocketFabric:
         if self.binary:
             meta, segs = codec.encode(payload)
             seg_bytes = sum(memoryview(s).nbytes for s in segs)
+            tid = trace_id & 0xFFFFFFFFFFFFFFFF
 
             def frame(seq: int) -> list:
                 return [_HDR.pack(K_CTRL, 0, tag, src, seq,
-                                  len(meta), seg_bytes, 0), meta, *segs]
+                                  len(meta), seg_bytes, tid), meta, *segs]
             self._send_frame(dst, frame,
                              _HDR.size + len(meta) + seg_bytes, frag=False,
                              snapshot=True)

@@ -51,6 +51,7 @@ import numpy as np
 from ..core.future import Future
 from ..core.params import params as _params
 from ..data.datatype import TileType
+from ..prof import spans as _spans
 from ..data_dist.collection import DictCollection
 from ..data_dist.paged_kv import PagedKVCollection
 from .decode import (decode_superpool_ptg, preallocate_decode_steps,
@@ -103,6 +104,10 @@ class StreamTicket:
         self.tokens: list[int] = []
         self.per_token_s: list[float] = []
         self.prefill_s: float | None = None
+        # the stream's trace context (prof/spans.py): the request-scoped
+        # identity of this generation, named by stall dumps and carried
+        # by every decode superpool ticket the stream rides
+        self.trace = _spans.new_trace()
         self._future: Future = Future()
 
     def generated(self) -> list[int]:
@@ -183,6 +188,10 @@ class ContinuousBatcher:
         seed_emb_table(self.model, self.EMB)
         self.max_batch = max_batch or _params.get("llm_max_batch")
         self.devices = devices
+        # the server's per-tenant SLO plane (prof/histogram.py): TTFT +
+        # inter-token latency land there, so RuntimeServer.metrics()
+        # answers "what are my per-tenant token p99s" live mid-run
+        self._slo = getattr(server, "_slo", None)
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._pending: deque[_Stream] = deque()
@@ -598,6 +607,19 @@ class ContinuousBatcher:
                 # not appends), and a done stream's pages free anyway
                 self.kv.note_appended(st.seq, st.k)
                 st.cur = toks[-1]
+                if self._slo is not None and toks:
+                    # the stream's first token closes its TTFT; every
+                    # token samples the inter-token latency (this
+                    # iteration's wall amortized over its k tokens)
+                    if not st.ticket.tokens:
+                        self._slo.observe(
+                            st.tenant, "ttft_ms",
+                            (time.monotonic()
+                             - st.ticket.submitted_at) * 1e3)
+                    tok_ms = dt / len(toks) * 1e3
+                    for _ in toks:
+                        self._slo.observe(st.tenant, "tok_latency_ms",
+                                          tok_ms)
                 with self._lock:
                     st.ticket.tokens.extend(toks)
                     st.ticket.per_token_s.extend([dt] * len(toks))

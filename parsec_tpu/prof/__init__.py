@@ -7,7 +7,13 @@
 - :mod:`counters` — SDE-style counters + the live properties dictionary;
 - :mod:`flight_recorder` — the always-on per-worker event rings, stall
   dump, metrics snapshotter, and the unified run-report export
-  (:func:`export_run_report` / :func:`runtime_report`).
+  (:func:`export_run_report` / :func:`runtime_report`);
+- :mod:`spans` — request-scoped trace contexts + the span recorder
+  (where did THIS request's latency go);
+- :mod:`histogram` — log-bucketed mergeable histograms + the per-tenant
+  SLO metrics plane;
+- :mod:`tracemerge` — per-rank Chrome traces stitched into one with
+  cross-rank flow arrows (dotmerge's sibling for time).
 """
 
 from . import pins
@@ -19,6 +25,10 @@ from .profiling import profiling as trace_state   # the global instance —
 from .counters import properties, sde
 from . import flight_recorder
 from .flight_recorder import export_run_report, runtime_report
+from . import spans
+from . import histogram
+from .histogram import LogHistogram, SLOPlane
+from .spans import TraceContext, new_trace
 from . import task_profiler as _task_profiler   # register components
 from . import grapher as _grapher               # register components
 from . import debug_marks as _debug_marks       # register components
@@ -26,4 +36,6 @@ from . import iterators_checker as _iterchk     # register components
 from . import perf_modules as _perf_modules     # register components
 
 __all__ = ["PinsEvent", "pins", "Profiling", "trace_state", "properties",
-           "sde", "flight_recorder", "export_run_report", "runtime_report"]
+           "sde", "flight_recorder", "export_run_report", "runtime_report",
+           "spans", "histogram", "LogHistogram", "SLOPlane",
+           "TraceContext", "new_trace"]

@@ -335,6 +335,23 @@ snapshotter = MetricsSnapshotter()
 # stall dump
 # ---------------------------------------------------------------------------
 
+# extra evidence providers for the stall report (the serving layer
+# registers per-tenant inflight counts + oldest live trace ids here, so
+# a wedged serve run names WHOSE request is stuck): name -> zero-arg fn
+_stall_sections: dict[str, Any] = {}
+_sections_lock = threading.Lock()
+
+
+def register_stall_section(name: str, fn: Any) -> None:
+    with _sections_lock:
+        _stall_sections[name] = fn
+
+
+def unregister_stall_section(name: str) -> None:
+    with _sections_lock:
+        _stall_sections.pop(name, None)
+
+
 def _best_effort(fn, default=None):
     try:
         return fn()
@@ -378,6 +395,10 @@ def build_stall_report(context: Any = None, reason: str = "",
         return [d.debug_state() for d in registry.devices
                 if hasattr(d, "debug_state")]
     report["devices"] = _best_effort(devices, default=[])
+    with _sections_lock:
+        sections = list(_stall_sections.items())
+    for name, fn in sections:
+        report.setdefault("sections", {})[name] = _best_effort(fn)
     return report
 
 
@@ -412,6 +433,8 @@ def stall_dump(context: Any = None, reason: str = "", last: int = 32,
         w(f"[flightrec]   comm={report['comm']}\n")
     for d in report.get("devices") or []:
         w(f"[flightrec]   device={d}\n")
+    for name, sec in (report.get("sections") or {}).items():
+        w(f"[flightrec]   {name}={sec}\n")
     path = None
     dirname = _params.get("prof_flightrec_dir")
     if dirname:
@@ -485,6 +508,15 @@ def runtime_report(max_workers: int = 6) -> dict:
             "completed": counts[PinsEvent.SERVE_COMPLETE],
             "drains": counts[PinsEvent.SERVE_DRAIN],
         }
+    # the per-tenant SLO plane (prof/histogram.py): quantile summaries
+    # merged across every live plane — present only when a serving
+    # workload recorded latency, so batch runs stay byte-compatible
+    def _slo():
+        from .histogram import merged_summary
+        return merged_summary()
+    slo = _best_effort(_slo, default={})
+    if slo:
+        rep["slo"] = slo
     now = _now()
 
     def activity(ring: _Ring) -> int:
@@ -549,9 +581,17 @@ def export_run_report(chrome_path: str | None = None) -> dict:
             if k.startswith("comm::") and isinstance(v, (int, float)):
                 events.append({"name": k, "ph": "C", "ts": ts, "pid": 2,
                                "args": {"value": v}})
+    from . import spans as _spans
+    if _spans.recorder is not None:
+        # request-scoped spans ride as pid 3 — same perf_counter_ns
+        # clock, so a request's exec/comm spans line up against the
+        # ring events and counter tracks (docs/OBSERVABILITY.md)
+        events.extend(_spans.to_chrome_events(pid=3))
     summary = runtime_report()
     summary["profiling_streams"] = len(profiling.streams)
     summary["trace_events"] = len(events)
+    if _spans.recorder is not None:
+        summary["spans"] = len(_spans.recorder.spans)
     summary["tasks_per_s"] = snapshotter.rates()[-3:]
     if chrome_path is not None:
         with open(chrome_path, "w") as f:

@@ -1,0 +1,215 @@
+"""Multi-rank Chrome-trace merger — dotmerge's sibling for TIME instead
+of structure: N per-rank trace files (written by
+:func:`parsec_tpu.prof.spans.export_chrome`, or any Chrome trace whose
+span events carry ``args.flow`` / ``args.flow_side``) union into ONE
+trace with **flow arrows** (``ph:"s"`` / ``ph:"f"`` events) across rank
+boundaries, so a request's activation hops and rendezvous GETs read as
+one connected timeline in Perfetto.
+
+::
+
+    python -m parsec_tpu.prof.tracemerge trace-rank0.json \\
+        trace-rank1.json -o merged.json
+    python -m parsec_tpu.prof.tracemerge --self-test
+
+Mechanics:
+
+- **clock alignment** — ``perf_counter_ns`` clocks are per-process; each
+  rank's export carries a ``parsec_clock_sync`` anchor (``unix_ns`` vs
+  ``perf_ns``), and every timestamp is shifted onto the shared
+  wall-clock axis before merging (host NTP skew, not relay latency, is
+  the residual error).
+- **pid namespacing** — rank *r*'s pids are remapped to ``r*100 + pid``
+  (the rank tag comes from the *filename*, ``rank<N>``, for the same
+  shell-glob reason as dotmerge).
+- **flow stitching** — span events whose args carry ``flow`` (e.g.
+  ``act:<src_rank>:<seq>``, ``get:<requester>:<get_id>``) and
+  ``flow_side`` (``emit``/``recv``) are matched by flow id; each matched
+  pair gains an ``s`` event bound to the emitting span and an ``f``
+  (``bp:"e"``) event bound to the receiving one.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+import zlib
+from typing import Any
+
+_RE_RANK = re.compile(r"rank(\d+)")
+
+
+def _rank_of(path: str, position: int) -> int:
+    """Rank tag from the filename (``rank<N>``) — shell globs sort
+    rank10 before rank2, so argv position would mislabel (the dotmerge
+    rule); falls back to argv position."""
+    m = _RE_RANK.search(path.rsplit("/", 1)[-1])
+    return int(m.group(1)) if m else position
+
+
+def _load_events(path: str) -> list[dict]:
+    with open(path) as f:
+        trace = json.load(f)
+    if isinstance(trace, list):
+        return trace
+    return trace.get("traceEvents", [])
+
+
+def merge_traces(paths: list[str], out_path: str | None = None) -> dict:
+    """Merge per-rank traces; returns stats (and writes the merged trace
+    when ``out_path`` is given)."""
+    merged: list[dict] = []
+    # flow id -> side -> first event seen (the hop endpoints)
+    flows: dict[str, dict[str, dict]] = {}
+    for pos, path in enumerate(paths):
+        rank = _rank_of(path, pos)
+        events = _load_events(path)
+        offset_us = 0.0
+        for ev in events:
+            if ev.get("name") == "parsec_clock_sync":
+                a = ev.get("args") or {}
+                if "unix_ns" in a and "perf_ns" in a:
+                    offset_us = (a["unix_ns"] - a["perf_ns"]) / 1e3
+                break
+        for ev in events:
+            ev = dict(ev)
+            pid = ev.get("pid", 0)
+            ev["pid"] = rank * 100 + (pid if isinstance(pid, int) else 0)
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + offset_us
+            merged.append(ev)
+            a = ev.get("args") or {}
+            fl, side = a.get("flow"), a.get("flow_side")
+            if fl and side in ("emit", "recv"):
+                flows.setdefault(fl, {}).setdefault(side, ev)
+    stitched = cross = 0
+    by_kind: dict[str, int] = {}
+    for fl, sides in sorted(flows.items()):
+        if "emit" not in sides or "recv" not in sides:
+            continue
+        e, r = sides["emit"], sides["recv"]
+        fid = zlib.crc32(fl.encode())
+        kind = fl.split(":", 1)[0]
+        # bind arrows to the MIDDLE of each span: s/f events attach to
+        # the slice enclosing their timestamp on that pid/tid, and the
+        # exact end boundary falls outside the slice
+        merged.append({"name": kind, "cat": "xtrace", "ph": "s",
+                       "id": fid, "pid": e["pid"], "tid": e.get("tid", 0),
+                       "ts": e["ts"] + e.get("dur", 0) / 2})
+        merged.append({"name": kind, "cat": "xtrace", "ph": "f",
+                       "bp": "e", "id": fid, "pid": r["pid"],
+                       "tid": r.get("tid", 0),
+                       "ts": r["ts"] + r.get("dur", 0) / 2})
+        stitched += 1
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+        if e["pid"] // 100 != r["pid"] // 100:
+            cross += 1
+    stats = {"events": len(merged), "flows_matched": stitched,
+             "cross_rank_flows": cross, "flows_by_kind": by_kind}
+    if out_path is not None:
+        with open(out_path, "w") as f:
+            json.dump({"traceEvents": merged}, f)
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# self-test (scripts/check.sh gate)
+# ---------------------------------------------------------------------------
+
+def _synthetic_rank(rank: int, perf_base: int, unix_base: int,
+                    spans: list[tuple[str, int, int, dict]]) -> dict:
+    """One rank's trace with a deliberately skewed perf clock, so the
+    self-test proves the clock alignment, not just the flow matching."""
+    events: list[dict[str, Any]] = [
+        {"name": "parsec_clock_sync", "ph": "i", "s": "g",
+         "ts": perf_base / 1e3, "pid": rank, "tid": 0,
+         "args": {"unix_ns": unix_base, "perf_ns": perf_base}},
+    ]
+    for name, t0, t1, args in spans:
+        events.append({"name": name, "cat": "span", "ph": "X",
+                       "ts": (perf_base + t0) / 1e3,
+                       "dur": max((t1 - t0) / 1e3, 0.001),
+                       "pid": rank, "tid": 0,
+                       "args": dict(args, trace="beef01")})
+    return {"traceEvents": events}
+
+
+def self_test() -> int:
+    """Synthesize a 2-rank trace pair — one activation hop, one
+    fragmented GET, per-rank perf clocks offset by seconds — merge, and
+    assert the arrows stitched and the alignment held."""
+    import os
+    import tempfile
+    unix0 = 1_700_000_000_000_000_000
+    r0 = _synthetic_rank(0, perf_base=5_000_000_000, unix_base=unix0, spans=[
+        ("comm.activate", 1000, 2000,
+         {"flow": "act:0:7", "flow_side": "emit"}),
+        ("comm.get_serve", 9000, 12000,
+         {"flow": "get:1:3", "flow_side": "emit"}),
+    ])
+    # rank 1's perf clock started at a wildly different origin; its wall
+    # clock is 5 µs ahead of rank 0's at anchor time
+    r1 = _synthetic_rank(1, perf_base=77_000_000_000,
+                         unix_base=unix0 + 5_000, spans=[
+        ("comm.activate", 4000, 5000,
+         {"flow": "act:0:7", "flow_side": "recv"}),
+        ("comm.get", 8000, 14000,
+         {"flow": "get:1:3", "flow_side": "recv"}),
+    ])
+    with tempfile.TemporaryDirectory(prefix="tracemerge_") as d:
+        p0, p1 = (os.path.join(d, f"trace-rank{r}.json") for r in (0, 1))
+        for p, t in ((p0, r0), (p1, r1)):
+            with open(p, "w") as f:
+                json.dump(t, f)
+        out = os.path.join(d, "merged.json")
+        stats = merge_traces([p0, p1], out)
+        assert stats["flows_matched"] == 2, stats
+        assert stats["cross_rank_flows"] == 2, stats
+        assert stats["flows_by_kind"] == {"act": 1, "get": 1}, stats
+        with open(out) as f:
+            evs = json.load(f)["traceEvents"]
+        s = [e for e in evs if e.get("ph") == "s"]
+        fl = [e for e in evs if e.get("ph") == "f"]
+        assert len(s) == 2 and len(fl) == 2, (s, fl)
+        # clock alignment: after the unix anchors applied, every rank's
+        # spans sit on one axis — the activation's recv must start
+        # AFTER its emit despite rank 1's perf clock being 72 s ahead
+        act_emit = next(e for e in evs if (e.get("args") or {})
+                        .get("flow") == "act:0:7"
+                        and e["args"]["flow_side"] == "emit")
+        act_recv = next(e for e in evs if (e.get("args") or {})
+                        .get("flow") == "act:0:7"
+                        and e["args"]["flow_side"] == "recv")
+        assert act_recv["ts"] > act_emit["ts"], (act_emit, act_recv)
+        assert act_recv["pid"] // 100 == 1 and act_emit["pid"] // 100 == 0
+    print("tracemerge self-test: ok (2 flows stitched, 2 cross-rank, "
+          "clock-aligned)")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--self-test" in argv:
+        return self_test()
+    out = "merged_trace.json"
+    if "-o" in argv:
+        i = argv.index("-o")
+        if i + 1 >= len(argv):
+            print(__doc__, file=sys.stderr)
+            return 2
+        out = argv[i + 1]
+        del argv[i:i + 2]
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    stats = merge_traces(argv, out)
+    print(f"{out}: {stats['events']} events, "
+          f"{stats['flows_matched']} flows stitched "
+          f"({stats['cross_rank_flows']} cross-rank, "
+          f"by kind {stats['flows_by_kind']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

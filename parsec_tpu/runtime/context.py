@@ -87,6 +87,10 @@ class Context:
         # can emit an event (prof_flightrec_size=0 opts out)
         from ..prof import flight_recorder as _flightrec
         _flightrec.ensure_installed()
+        # request-scoped span recorder (prof_spans=1): installed before
+        # any worker runs, so a traced pool's first task is never missed
+        from ..prof import spans as _spans
+        _spans.ensure_installed()
         if nb_cores is None:
             nb_cores = _params.get("runtime_num_cores")
         self.nb_cores = nb_cores

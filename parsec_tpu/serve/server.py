@@ -42,7 +42,9 @@ from typing import Any, Callable
 
 from ..core.future import Future
 from ..core.params import params as _params
-from ..prof import pins
+from ..prof import flight_recorder as _flightrec
+from ..prof import pins, spans as _spans
+from ..prof.histogram import SLOPlane
 from ..prof.pins import PinsEvent
 from ..runtime.context import Context, ContextWaitTimeout
 from ..runtime.taskpool import Taskpool
@@ -91,7 +93,12 @@ class Ticket:
         self.state = "queued"
         self.deadline_missed = False
         self.submitted_at = time.monotonic()
+        self.admitted_at: float | None = None
+        self.started_at: float | None = None
         self.completed_at: float | None = None
+        # the request's trace context (prof/spans.py): minted at submit,
+        # attached to the taskpool, carried across ranks by the wire
+        self.trace = _spans.new_trace()
         self._future: Future = Future()
         self._slock = threading.Lock()
         self._settled = False
@@ -216,6 +223,25 @@ class RuntimeServer:
         self.rejected = 0
         self.per_tenant_completed: dict[str, int] = {}
         self._llm = None            # lazy ContinuousBatcher (submit_stream)
+        # the per-tenant SLO metrics plane (prof/histogram.py): queue
+        # wait, end-to-end latency, admission sheds here; the LLM
+        # batcher adds TTFT + inter-token latency.  runtime_report's
+        # `slo` block and the live `slo` property aggregate it for free.
+        self._slo = SLOPlane()
+        self._drain_s: float | None = None
+        # stall dumps name WHOSE request is stuck: per-tenant inflight
+        # counts + the oldest live trace id (flight_recorder sections).
+        # Registered through a weakref — the global registry must never
+        # keep a leaked (never-drained) server alive.
+        import weakref
+        self._stall_key = f"serve@{id(self):x}"
+        ref = weakref.ref(self)
+
+        def _section() -> dict:
+            s = ref()
+            return s._stall_section() if s is not None else {}
+
+        _flightrec.register_stall_section(self._stall_key, _section)
         self._ctx.add_failure_listener(self._on_context_failure)
         self._ctx.start()
 
@@ -264,10 +290,23 @@ class RuntimeServer:
             pins.fire(PinsEvent.SERVE_REJECT, None, (tenant, tp.name))
             with self._lock:
                 self.rejected += 1
+            if not isinstance(e, TicketCancelled):
+                # a voluntary client cancel is NOT an admission shed:
+                # the SLO counter must attribute only controller/drain
+                # pressure, or operators read cancels as backpressure
+                self._slo.inc(tenant, "admission_sheds")
             ticket._fail(e, state="cancelled"
                          if isinstance(e, TicketCancelled) else "rejected")
             raise
         pins.fire(PinsEvent.SERVE_ADMIT, None, (tenant, tp.name))
+        ticket.admitted_at = time.monotonic()
+        wait_s = ticket.admitted_at - ticket.submitted_at
+        self._slo.observe(tenant, "admission_wait_ms", wait_s * 1e3)
+        r = _spans.recorder
+        if r is not None:
+            t1 = time.perf_counter_ns()
+            r.record("serve.admission", ticket.trace.trace_id,
+                     t1 - int(wait_s * 1e9), t1, tenant=tenant)
         sub = _Submission(tenant, priority, deadline_at, cost, ticket,
                           result_fn)
         tp._serve_sub = sub
@@ -289,6 +328,10 @@ class RuntimeServer:
                 self.rejected += 1
         if not started or closed:
             self._adm.release(tenant, cost)
+            if started:
+                # shed by the drain window; !started is a client cancel
+                # and stays out of the admission_sheds attribution
+                self._slo.inc(tenant, "admission_sheds")
             pins.fire(PinsEvent.SERVE_REJECT, None, (tenant, tp.name))
             e: AdmissionRejected = TicketCancelled(
                 "ticket cancelled before start") if not started \
@@ -301,6 +344,12 @@ class RuntimeServer:
         # before enqueue for the same reason — a synchronously-completing
         # pool must record SUBMIT → ADMIT → START → COMPLETE in order
         pins.fire(PinsEvent.SERVE_START, None, (tenant, tp.name))
+        ticket.started_at = time.monotonic()
+        # the request's trace rides the pool: task-grain spans and the
+        # cross-rank wire protocol key off tp._trace from here on
+        tp._trace = ticket.trace
+        if _spans.recorder is not None:
+            tp._trace_enq_ns = time.perf_counter_ns()
         tp.add_completion_listener(self._on_pool_done)
         try:
             self._ctx.add_taskpool(tp)
@@ -417,6 +466,21 @@ class RuntimeServer:
             elif settled:
                 self.failed += 1
             self._cond.notify_all()
+        tk = sub.ticket
+        if ok and tk.completed_at is not None:
+            # the request's SLO samples: submit -> start (admission +
+            # queue) and the end-to-end ticket latency
+            if tk.started_at is not None:
+                self._slo.observe(sub.tenant, "queue_wait_ms",
+                                  (tk.started_at - tk.submitted_at) * 1e3)
+            lat = tk.completed_at - tk.submitted_at
+            self._slo.observe(sub.tenant, "latency_ms", lat * 1e3)
+            r = _spans.recorder
+            if r is not None:
+                t1 = time.perf_counter_ns()
+                r.record("serve.request", tk.trace.trace_id,
+                         t1 - int(lat * 1e9), t1, tenant=sub.tenant,
+                         args={"pool": tp.name})
 
     def _on_context_failure(self, e: BaseException) -> None:
         """Context poison (a worker died): fail every in-flight ticket so
@@ -443,6 +507,7 @@ class RuntimeServer:
         remaining tickets fail with :class:`ContextWaitTimeout` and the
         context tears down abort-style (stall dump fires) — the server is
         DOWN either way when this returns/raises."""
+        t_drain0 = time.monotonic()
         with self._lock:
             llm = self._llm
         if llm is not None:
@@ -488,6 +553,9 @@ class RuntimeServer:
             self._ctx.fini(timeout=rem)
         finally:
             self._drained.set()     # the server is DOWN, success or not
+            self._drain_s = time.monotonic() - t_drain0
+            self._slo.observe("_server", "drain_ms", self._drain_s * 1e3)
+            _flightrec.unregister_stall_section(self._stall_key)
         if leftover:
             raise ContextWaitTimeout(
                 f"server drain timed out ({len(leftover)} submissions "
@@ -511,11 +579,47 @@ class RuntimeServer:
                 self._draining = True
             self._ctx.abort()
             self._drained.set()
+            _flightrec.unregister_stall_section(self._stall_key)
 
     # -- introspection ---------------------------------------------------
     @property
     def context(self) -> Context:
         return self._ctx
+
+    def metrics(self) -> dict:
+        """The live per-tenant SLO snapshot (docs/SERVING.md): quantile
+        summaries off the histogram plane — TTFT and inter-token latency
+        (LLM streams), queue wait, end-to-end latency, admission waits
+        and sheds — callable MID-RUN with no synchronization against the
+        serving path (histograms are read without locking; a racing
+        record at worst misses the snapshot by one sample)."""
+        with self._lock:
+            inflight = len(self._inflight)
+        return {
+            "tenants": self._slo.summary(),
+            "inflight": inflight,
+            "drain_s": self._drain_s,
+            "admission": self._adm.stats(),
+        }
+
+    def _stall_section(self) -> dict:
+        """Per-tenant inflight counts + the oldest live request's trace
+        id — the stall-dump block that names WHOSE request is stuck."""
+        with self._lock:
+            tickets = list(self._inflight)
+        now = time.monotonic()
+        out: dict[str, dict] = {}
+        for tk in tickets:
+            d = out.setdefault(tk.tenant, {"inflight": 0,
+                                           "oldest_trace_id": None,
+                                           "oldest_age_s": -1.0,
+                                           "oldest_pool": None})
+            d["inflight"] += 1
+            age = now - tk.submitted_at
+            if age > d["oldest_age_s"]:
+                d.update(oldest_trace_id=format(tk.trace.trace_id, "x"),
+                         oldest_age_s=round(age, 3), oldest_pool=tk.name)
+        return out
 
     def stats(self) -> dict:
         with self._lock:

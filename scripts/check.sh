@@ -30,6 +30,17 @@ else
     echo "== runtimelint + graphcheck (every shipped model graph) =="
     python -m parsec_tpu.analysis
 
+    echo "== tracemerge (cross-rank trace stitching self-test) =="
+    python -m parsec_tpu.prof.tracemerge --self-test
+
+    echo "== tracing overhead gate (disabled span path within 10% of" \
+         "the overhead baseline; allocation-free; enabled <=1us budget" \
+         "at headroom) =="
+    python -m pytest tests/test_perf_smoke.py -q -k tracing \
+        -p no:cacheprovider
+    python -m pytest tests/test_tracing.py -q \
+        -k "allocation_free" -p no:cacheprovider
+
     echo "== llm microbench (smoke: tokens/s through the serving stack," \
          "swept over llm_steps_per_pool — superpool amortization) =="
     python -c 'import json, microbench; \

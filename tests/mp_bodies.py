@@ -140,6 +140,58 @@ def distributed_bootstrap_body(ctx, rank, nranks):
     return out
 
 
+def traced_get_body(ctx, rank, nranks):
+    """ISSUE 10: a cross-rank chain with the SPAN recorder observing —
+    big tiles force the rendezvous GET path (and, with the parent's
+    small ``comm_get_frag_bytes``, FRAGMENTED GETs), so each rank's
+    exported Chrome trace carries activation emit/recv spans and GET
+    request/serve spans whose flow ids tracemerge stitches across the
+    rank boundary.  Both ranks share one deterministic trace id (the
+    rank-agreed analog of a server-minted context)."""
+    import os
+
+    from parsec_tpu import ptg
+    from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
+    from parsec_tpu.prof import spans
+
+    spans.install()
+    out_dir = os.environ["PARSEC_TEST_TRACE_DIR"]
+    MB = 8192            # 32 KiB float32 tiles: > comm_short_limit, and
+    NB = 2 * nranks      # > the test's comm_get_frag_bytes (fragmented)
+    V = VectorTwoDimCyclic("V", lm=NB * MB, mb=MB, P=nranks, myrank=rank,
+                           init_fn=lambda m, size:
+                           np.zeros(size, np.float32))
+    p = ptg.PTGBuilder("tracedchain", V=V, NB=NB)
+    t = p.task("T", i=ptg.span(0, lambda g, l: g.NB - 1))
+    t.affinity("V", lambda g, l: (l.i,))
+    f = t.flow("A", ptg.RW)
+    f.input(data=("V", lambda g, l: (0,)), guard=lambda g, l: l.i == 0)
+    f.input(pred=("T", "A", lambda g, l: {"i": l.i - 1}),
+            guard=lambda g, l: l.i > 0)
+    f.output(succ=("T", "A", lambda g, l: {"i": l.i + 1}),
+             guard=lambda g, l: l.i < g.NB - 1)
+    f.output(data=("V", lambda g, l: (l.i,)),
+             guard=lambda g, l: l.i == g.NB - 1)
+
+    @t.body
+    def body(es, task, g, l):
+        a = task.flow_data("A")
+        a.value = np.asarray(a.value) + 1
+
+    tp = p.build()
+    # one trace id agreed by construction on every rank (a server run
+    # propagates it over the wire instead)
+    tp._trace = spans.TraceContext(0xBEEF01)
+    ctx.add_taskpool(tp)
+    ctx.wait(timeout=90)
+    ctx.comm_barrier()
+    spans.export_chrome(os.path.join(out_dir, f"trace-rank{rank}.json"),
+                        rank=rank)
+    names = {s[0] for s in spans.recorder.spans}
+    spans.uninstall()
+    return sorted(names)
+
+
 def traced_chain_body(ctx, rank, nranks):
     """Chain across ranks with the task_profiler + grapher observing:
     each rank dumps its OWN binary trace and DOT fragment (the

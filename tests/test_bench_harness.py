@@ -198,6 +198,57 @@ def test_llm_mid_sweep_deadline_keeps_all_completed_points():
         bench._abandoned[:] = prior
 
 
+def test_note_partial_flushes_slo_histograms():
+    """ISSUE-10 satellite: every ``_note_partial`` flush snapshots the
+    live SLO histogram planes as SERIALIZED BUCKET ARRAYS, so a
+    deadline death mid-serve/llm stage keeps the latency distribution
+    collected so far (reconstructable via ``LogHistogram.from_dict``),
+    not just the counters."""
+    import bench
+    from parsec_tpu.prof.histogram import LogHistogram, SLOPlane
+
+    plane = SLOPlane()              # stays referenced through the stage
+    for v in (3.0, 12.5, 40.0):
+        plane.observe("tenantX", "ttft_ms", v)
+
+    def fake_slo_stage():
+        bench._note_partial(phase="llm", point=1)
+        time.sleep(30)
+
+    prior = list(bench._abandoned)
+    try:
+        res = bench._staged("fakeslo", fake_slo_stage, timeout=0.3)
+        assert res["status"] == "timeout", res
+        sh = res["partial"]["slo_hist"]
+        assert "tenantX" in sh, sh
+        h = LogHistogram.from_dict(sh["tenantX"]["ttft_ms"])
+        assert h.count == 3
+        assert h.quantile(0.5) > 0
+    finally:
+        bench._abandoned[:] = prior
+        plane.reset()
+
+
+def test_serve_and_llm_stages_emit_per_tenant_slo(smoke_run):
+    """ISSUE-10 acceptance: the serve and llm stages emit per-tenant
+    quantiles off the histogram plane — the llm stage ttft/tok-latency
+    p50/p99 per tenant, the serve stage queue-wait/latency."""
+    last = _json_lines(smoke_run[0].stdout)[-1]
+    llm_slo = last["extra"]["llm"]["llm_slo"]
+    assert llm_slo, last["extra"]["llm"].keys()
+    for tenant, d in llm_slo.items():
+        assert d["ttft_ms_p50"] > 0, (tenant, d)
+        assert d["ttft_ms_p99"] >= d["ttft_ms_p50"], (tenant, d)
+        assert d["tok_latency_ms_p99"] >= d["tok_latency_ms_p50"] > 0
+    serve_slo = last["extra"]["serve"]["serve_slo"]
+    tenants = [t for t in serve_slo if t.startswith("tenant")]
+    assert tenants, serve_slo.keys()
+    for t in tenants:
+        assert serve_slo[t]["latency_ms_p99"] >= \
+            serve_slo[t]["latency_ms_p50"] > 0
+        assert serve_slo[t]["queue_wait_ms_count"] > 0
+
+
 def test_lowered_stages_report_compile_seconds(smoke_run):
     last = _json_lines(smoke_run[0].stdout)[-1]
     assert last["extra"]["lowered_cholesky_compile_s"] > 0

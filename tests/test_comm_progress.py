@@ -21,7 +21,7 @@ class _SpyEngine:
         self.sent = []
         self.rank, self.nranks = 0, 4
 
-    def send_am(self, tag, dst, payload):
+    def send_am(self, tag, dst, payload, trace_id=0):
         self.sent.append((tag, dst, payload))
 
     def tag_register(self, tag, cb):

@@ -156,6 +156,37 @@ def test_llm_decode_throughput_and_latency():
         "submits_per_token"], r
 
 
+# ISSUE-10 tracing budget (docs/OBSERVABILITY.md overhead table):
+# disabled = the existing PINS one-branch cost, so the dynamic dispatch
+# number must stay within 10% of the PR-2 overhead baseline gate;
+# enabled = ≤1µs/task budget, gated at 10x headroom plus the noise
+# floor of differencing two ~40µs dynamic-dispatch medians (measured
+# ±4µs idle, up to ~2x that on a loaded CI box)
+TRACING_DISABLED_RATIO_MAX = 1.10
+TRACING_ENABLED_DELTA_US_MAX = 20.0
+SPAN_RECORD_NS_MAX = 5000.0
+HIST_RECORD_NS_MAX = 10000.0
+
+
+def test_tracing_overhead_within_budget():
+    """The ISSUE-10 observability gates: with the span recorder
+    UNINSTALLED (the shipped default) the dynamic dispatch path costs
+    what it cost at the PR-2 baseline (within the 10% ratio the issue
+    pins — tracing added NO new hot-path site, only the existing PINS
+    branch); INSTALLED with every pool traced, the per-task delta stays
+    inside the ≤1µs budget line held at headroom.  Span and histogram
+    record costs are gated directly so a regression names the layer."""
+    r = microbench.bench_tracing(smoke=True)
+    assert r["tracing_dispatch_off_us"] <= \
+        DYNAMIC_DISPATCH_US_MAX * TRACING_DISABLED_RATIO_MAX, r
+    assert r["tracing_dispatch_delta_us"] <= \
+        TRACING_ENABLED_DELTA_US_MAX, r
+    assert r["span_record_ns"] <= SPAN_RECORD_NS_MAX, r
+    assert r["hist_record_ns"] <= HIST_RECORD_NS_MAX, r
+    # the enabled run really recorded: traced pools span every task
+    assert r["tracing_spans_recorded"] > 0, r
+
+
 def test_lowering_cache_warm_compile_is_near_zero():
     r = microbench.bench_lowering_cache(n=64, nb=32)
     assert r["cache_hits"] >= 1, r
