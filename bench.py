@@ -718,9 +718,22 @@ def bench_llm_stage() -> dict:
     accelerator weather."""
     import os
 
-    from microbench import bench_llm
-    out = bench_llm(smoke=os.environ.get("BENCH_SMOKE") == "1",
-                    note=_note_partial)
+    from microbench import bench_llm, bench_llm_prefix, bench_llm_tier
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    out = bench_llm(smoke=smoke, note=_note_partial)
+    # the serving-memory axes (ISSUE 11), each flushing per point so a
+    # deadline death keeps whatever swept: the shared-prefix-fraction
+    # sweep (TTFT p50/p99 + prefill_skipped_frac per point, headline
+    # llm_prefix_ttft_speedup vs trie-off) and the HBM-squeeze tier run
+    # (tokens/s ratio with the device budget below the working set)
+    try:
+        out.update(bench_llm_prefix(smoke=smoke, note=_note_partial))
+    except Exception as e:            # noqa: BLE001 — evidence over abort
+        out["llm_prefix_error"] = f"{type(e).__name__}: {e}"
+    try:
+        out.update(bench_llm_tier(smoke=smoke, note=_note_partial))
+    except Exception as e:            # noqa: BLE001 — evidence over abort
+        out["llm_tier_error"] = f"{type(e).__name__}: {e}"
     out["gflops"] = 0.0   # not a compute stage; keep the stage shape
     return out
 

@@ -560,6 +560,220 @@ def bench_llm(streams_sweep: tuple = (1, 4, 8),
     return out
 
 
+def bench_llm_prefix(fracs: tuple = (0.0, 0.5, 0.9), nstreams: int = 8,
+                     shared_pages: int = 12, tail_len: int = 8,
+                     new_tokens: int = 2, nb_cores: int = 2,
+                     page_size: int = 256, reps: int = 2,
+                     smoke: bool = False, note=None) -> dict:
+    """The automatic-prefix-cache axis (ISSUE 11): TTFT p50/p99 and the
+    prefill work actually skipped, swept over the **shared-prefix
+    fraction** of the traffic — the millions-of-users shape is most
+    requests carrying one system prompt, and the radix trie
+    (``llm/prefix_tree.py``) should convert exactly that fraction of
+    prefill into copy-on-write page forks.
+
+    Per swept point: a fresh server + batcher with ``llm_prefix_cache=1``
+    is warmed by ONE donor stream (its retirement donates the shared
+    prompt's pages to the trie), then ``nstreams`` streams arrive of
+    which ``frac`` share the donor's prefix (plus per-stream tails — the
+    hit-mid-page shape) and the rest carry disjoint prompts (misses).
+    TTFT is client-observed: ``StreamTicket.first_token_at`` minus
+    submit.  The headline ``llm_prefix_ttft_speedup`` re-runs the top
+    fraction with the cache OFF and reports cold/hot TTFT p50 — the
+    perf_smoke ``LLM_PREFIX_TTFT_SPEEDUP_MIN`` gate holds it ≥ 2x.
+    ``note(**kw)`` fires per point (deadline-death keeps sweep points,
+    the BENCH_r04/r05 lesson).  Pure CPU serving path.
+
+    Geometry: 256-token pages — prefill work per cacheable token (chunk
+    building + PF page copies) then dominates scheduler task overhead,
+    so the measured speedup reflects the work the trie skips rather
+    than the per-task cost the superpool axis already measures.  Each
+    point runs ``reps`` waves on one hot server and keeps the best p50
+    (arrival/iteration phase alignment is the flake source; the wave
+    with the cleanest batch boundary is the representative one)."""
+    import parsec_tpu.llm.batcher  # noqa: F401 — registers llm_* params
+    from parsec_tpu.core.params import params as _params
+    from parsec_tpu.llm import ToyLM
+    from parsec_tpu.serve import RuntimeServer
+
+    if smoke:
+        fracs, nstreams = (0.0, 0.9), 6
+    model = ToyLM()
+    P = int(page_size)
+    shared = [(5 * i + 11) % model.vocab for i in range(shared_pages * P)]
+    saved = {k: _params.get(k) for k in ("llm_prefix_cache",
+                                         "llm_steps_per_pool",
+                                         "llm_page_size")}
+    # 1-step superpools: TTFT then measures admission + prefill + one
+    # decode step, so the prefill skip is visible instead of drowned
+    # under a k-step first iteration
+    _params.set("llm_steps_per_pool", 1)
+    _params.set("llm_page_size", P)
+
+    def run_point(frac: float, cache_on: bool) -> dict:
+        _params.set("llm_prefix_cache", cache_on)
+        with RuntimeServer(nb_cores=nb_cores) as server:
+            donor = server.submit_stream(shared + [3], max_new_tokens=1,
+                                         tenant="pfx")
+            donor.result(timeout=300)      # retires -> donates the prefix
+            llm0 = server.stats()["llm"]
+            nshared = int(round(frac * nstreams))
+            best = None
+            for rep in range(max(1, reps)):
+                # unique parts vary PER WAVE: a later wave's misses must
+                # stay misses (the earlier wave's retirees donated their
+                # prompts), or the 0.0 point would silently measure
+                # repeat-traffic hits instead of the cold path
+                prompts = []
+                for i in range(nstreams):
+                    # distinct mod vocab across (wave, stream) pairs, so
+                    # no two "unique" prompts ever alias page runs
+                    salt = (rep * nstreams + i) % model.vocab
+                    if i < nshared:        # shared prefix + unique tail
+                        prompts.append(shared
+                                       + [(salt + j) % model.vocab
+                                          for j in range(tail_len)])
+                    else:                  # disjoint prompt, same length
+                        prompts.append([(7 * salt + 3 * j + 1)
+                                        % model.vocab
+                                        for j in range(len(shared)
+                                                       + tail_len)])
+                t0 = time.perf_counter()
+                tks = [server.submit_stream(p, max_new_tokens=new_tokens,
+                                            tenant="pfx") for p in prompts]
+                for tk in tks:
+                    tk.result(timeout=300)
+                wall = time.perf_counter() - t0
+                ttfts = sorted((tk.first_token_at - tk.submitted_at) * 1e3
+                               for tk in tks
+                               if tk.first_token_at is not None)
+                n = len(ttfts)
+                wave = {
+                    "ttft_p50_ms": round(ttfts[n // 2], 3) if n else 0.0,
+                    "ttft_p99_ms": round(
+                        ttfts[min(int(n * 0.99), n - 1)], 3) if n else 0.0,
+                    "tokens_per_s": round(
+                        nstreams * new_tokens / wall, 1),
+                }
+                if best is None or wave["ttft_p50_ms"] < best["ttft_p50_ms"]:
+                    best = wave
+            llm1 = server.stats()["llm"]
+            d_tot = (llm1["prefill_tokens_total"]
+                     - llm0["prefill_tokens_total"])
+            d_skip = (llm1["prefill_tokens_skipped"]
+                      - llm0["prefill_tokens_skipped"])
+            best["prefill_skipped_frac"] = round(d_skip / max(1, d_tot), 4)
+            best["prefix_hits"] = (llm1["kv"]["prefix_hits"]
+                                   - llm0["kv"]["prefix_hits"])
+            return best
+
+    out: dict = {"llm_prefix_sweep": {}}
+    try:
+        for frac in fracs:
+            point = run_point(frac, cache_on=True)
+            out["llm_prefix_sweep"][str(frac)] = point
+            if note is not None:
+                note(phase="llm_prefix",
+                     **{f"llm_prefix_f{frac}": point})
+        top = max(fracs)
+        cold = run_point(top, cache_on=False)
+        out["llm_prefix_cold"] = cold
+        hot = out["llm_prefix_sweep"][str(top)]
+        out["llm_prefix_ttft_speedup"] = round(
+            cold["ttft_p50_ms"] / max(hot["ttft_p50_ms"], 1e-9), 2)
+        out["llm_prefill_skipped_frac"] = hot["prefill_skipped_frac"]
+        out["llm_prefix_shared_tokens"] = len(shared)
+        if note is not None:
+            note(phase="llm_prefix",
+                 llm_prefix_ttft_speedup=out["llm_prefix_ttft_speedup"],
+                 llm_prefill_skipped_frac=out["llm_prefill_skipped_frac"])
+    finally:
+        for k, v in saved.items():
+            _params.set(k, v)
+    return out
+
+
+def bench_llm_tier(nstreams: int = 4, prompt_pages: int = 3,
+                   new_tokens: int = 24, nb_cores: int = 2,
+                   smoke: bool = False, note=None) -> dict:
+    """The KV-tiering axis (ISSUE 11): the SAME decode workload through
+    the accelerator device tier twice — unconstrained, then with the
+    device HBM budget squeezed BELOW the live-KV working set — reporting
+    the tokens/s ratio (the "prefetch hides the spill" claim: the
+    acceptance line is within 30%) plus the tier ledger
+    (``host_tier_bytes``, spills, prefetched pages) of the constrained
+    run.  Off-TPU the device is the host CPU wrapped as an accelerator
+    (the same CPU-coverage trick the device suites use), so the number
+    is CPU-provable; tokens are oracle-checked in both runs."""
+    import jax
+
+    import parsec_tpu.llm.batcher  # noqa: F401 — registers llm_* params
+    from parsec_tpu.device import registry
+    from parsec_tpu.device.tpu import TPUDevice
+    from parsec_tpu.llm import ContinuousBatcher, ToyLM
+    from parsec_tpu.serve import RuntimeServer
+
+    if smoke:
+        # >= 2 superpool iterations (k=8): iteration N's evictions are
+        # what iteration N+1's prefetch stages back — a single-shot run
+        # would race the deferred write-back drain and prefetch nothing
+        nstreams, new_tokens = 2, 16
+    model = ToyLM()
+
+    def run_once(budget_pages: int | None) -> tuple[float, dict]:
+        snapshot = list(registry.devices)
+        dev = TPUDevice(jax.devices()[0])
+        registry.add(dev)
+        try:
+            with RuntimeServer(nb_cores=nb_cores) as server:
+                b = ContinuousBatcher(server, model=model, devices="tpu")
+                # one warmup stream BEFORE the timed batch: both runs
+                # then measure steady-state decode, not whichever run
+                # happened to pay the process's first jit/vmap builds
+                b.submit_stream([1, 2, 3], max_new_tokens=1) \
+                    .result(timeout=300)
+                if budget_pages is not None:
+                    dev._mem_budget = budget_pages * b.kv.page_bytes
+                P = b.kv.page_size
+                prompts = [[(7 * i + 3 * j + 1) % model.vocab
+                            for j in range(prompt_pages * P + 1)]
+                           for i in range(nstreams)]
+                t0 = time.perf_counter()
+                tks = [b.submit_stream(p, max_new_tokens=new_tokens)
+                       for p in prompts]
+                for p, tk in zip(prompts, tks):
+                    got = tk.result(timeout=300)["tokens"]
+                    want = model.reference_generate(p, new_tokens)
+                    assert got == want, ("tiered decode diverged from "
+                                        "the dense oracle", got, want)
+                wall = time.perf_counter() - t0
+                stats = b.stats()
+                b.stop()
+            return nstreams * new_tokens / wall, stats
+        finally:
+            registry.devices = snapshot
+            for i, d in enumerate(registry.devices):
+                d.device_index = i
+
+    tok_free, _ = run_once(None)
+    # working set ~= nstreams * (prompt + decode tail) pages; squeeze to
+    # roughly a third so eviction pressure is real every iteration
+    squeeze = max(2, nstreams * (prompt_pages + 1) // 3)
+    tok_tight, stats = run_once(squeeze)
+    out = {
+        "llm_tier_tokens_per_s_free": round(tok_free, 1),
+        "llm_tier_tokens_per_s_tight": round(tok_tight, 1),
+        "llm_tier_tokens_ratio": round(tok_tight / max(tok_free, 1e-9), 3),
+        "llm_tier_budget_pages": squeeze,
+        "llm_tier_spills": stats["tiers"]["spills"],
+        "llm_tier_prefetched_pages": stats["tiers"]["prefetched_pages"],
+        "llm_tier_host_bytes_peak": stats["kv"]["host_tier_bytes"],
+    }
+    if note is not None:
+        note(phase="llm_tier", **out)
+    return out
+
+
 def _comm_socket_pair():
     """Two socket fabrics + engines in one process on a free localhost
     port range (the oversubscribed two-rank DCN shape)."""
@@ -794,6 +1008,14 @@ def run_all(smoke: bool = False, include_lowering: bool = True,
                                depth=4 if smoke else 8))
     if include_llm:
         out.update(bench_llm(smoke=smoke))
+        try:
+            out.update(bench_llm_prefix(smoke=smoke))
+        except Exception as e:        # noqa: BLE001 — evidence over abort
+            out["llm_prefix_error"] = f"{type(e).__name__}: {e}"
+        try:
+            out.update(bench_llm_tier(smoke=smoke))
+        except Exception as e:        # noqa: BLE001 — evidence over abort
+            out["llm_tier_error"] = f"{type(e).__name__}: {e}"
     if include_comm:
         out.update(bench_comm(smoke=smoke))
     if include_lowering:

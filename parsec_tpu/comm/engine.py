@@ -197,6 +197,7 @@ class CommEngine:
         self._mem: dict[int, MemHandle] = {}
         self._mem_lock = threading.Lock()
         self._enabled = False
+        self.prefetch_gets = 0     # lookahead GETs issued (prefetch_get)
         # upper-layer flush callback (the remote-dep outgoing stage): every
         # progress() drives it, so loops that spin on raw engine progress
         # (sync, quiesce) can never strand staged sends
@@ -287,6 +288,20 @@ class CommEngine:
         rides the GET request so BOTH ends span-record the transfer
         under the originating request's trace."""
         raise NotImplementedError
+
+    def prefetch_get(self, rwire: tuple[int, int],
+                     on_complete: Callable[[Any], None],
+                     trace: int | None = None) -> None:
+        """A GET issued AHEAD of demand (ISSUE 11): same wire protocol
+        — credit-windowed fragmented replies included — but tallied
+        separately (``prefetch_gets``, the COMM_GET_PREFETCH PINS
+        event → ``runtime_report``'s comm block, and ``frag_state`` in
+        stall dumps) so wavefront lookahead (the KV tier map paging a
+        cold sequence back one superpool early) is distinguishable
+        from on-demand dependency pulls."""
+        self.prefetch_gets += 1
+        pins.fire(PinsEvent.COMM_GET_PREFETCH, None, rwire[0])
+        self.get(rwire, on_complete, trace=trace)
 
     # -- lifecycle / progress -------------------------------------------------
     def enable(self) -> None:
@@ -627,7 +642,8 @@ class InprocCommEngine(CommEngine):
                     "frag_bytes_in": self.frag_bytes_in,
                     "frags_out": self.frags_out,
                     "frag_bytes_out": self.frag_bytes_out,
-                    "dup_frags": self.dup_frags}
+                    "dup_frags": self.dup_frags,
+                    "prefetch_gets": self.prefetch_gets}
 
     def on_peer_failed(self, rank: int) -> int:
         # a dead consumer's open send windows are abandoned (its credit

@@ -1,0 +1,401 @@
+"""Tiered residency for paged KV: HBM -> host arena -> peer rank.
+
+The other half of ISSUE 11's tentpole: live-stream count must not be
+capped by the device HBM budget.  KV pages are plain
+:class:`~parsec_tpu.data.data.Data`, so the device module's LRU already
+*evicts* them (write-back to the host copy, ``device/tpu.py``) — what
+was missing is the bookkeeping and the return path:
+
+- :class:`KVTierMap` subscribes to the device eviction hook
+  (``device.tpu.register_spill_hook``) and keeps the **host-tier
+  ledger**: which of its collection's pages are host-resident-only and
+  how many bytes they hold (``host_tier_bytes`` — surfaced through
+  ``PagedKVCollection.stats()``, ``runtime_report()["llm"]`` and the
+  serving SLO plane).
+- :meth:`prefetch_seqs` stages spilled pages BACK into the device tier
+  ahead of the decode wavefront (``TPUDevice.prefetch_data`` — one
+  async ``device_put`` that overlaps in-flight dispatches).  The
+  batcher calls it right after submitting an iteration's superpools,
+  so a paged-out stream re-enters decode without a synchronous stall.
+- Optionally, cold host-tier pages spill one hop further to a **peer
+  rank** over the PR-4 wire path: :meth:`attach_peer` wires a comm
+  engine; spills push page bytes with an AM, the peer pins them in a
+  :class:`PeerKVStore` under a registered :class:`~parsec_tpu.comm
+  .engine.MemHandle`, and the return trip is a credit-windowed
+  (fragmented, for large pages) prefetch GET
+  (``CommEngine.prefetch_get``).  "Large Scale Distributed Linear
+  Algebra With TPUs" (arxiv 2112.09017) is the multi-host memory
+  regime this tier points at.
+
+Locking: the tier lock is leaf-level — never held across calls into the
+collection, the device, or the engine — so it can never deadlock against
+``PagedKVCollection.stats()`` (kv lock -> tier reads) or the batcher's
+prefetch path (tier -> device locks).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, Sequence
+
+import numpy as np
+
+from ..core.params import params as _params
+from ..data.data import COHERENCY_INVALID, COHERENCY_SHARED
+from .paged_kv import PagedKVCollection
+
+_params.register("kv_host_tier_bytes", 0,
+                 "byte budget for host-tier (device-evicted) KV pages; "
+                 "past it, cold pages spill one hop further to the "
+                 "attached peer rank (0 = unbounded host tier, no peer "
+                 "spill pressure)")
+
+# AM tags for the peer tier (user tag space, comm/engine.py)
+AM_TAG_KV_SPILL = 24        # (key, version, ndarray) -> peer pins it
+AM_TAG_KV_SPILL_ACK = 25    # (key, mem-handle wire) -> spiller records it
+
+
+class KVTierMap:
+    """Residency ledger + prefetcher for one :class:`PagedKVCollection`
+    (see module docstring)."""
+
+    def __init__(self, kv: PagedKVCollection) -> None:
+        self.kv = kv
+        kv.tier = self        # stats() answers tier keys through us
+        self._lock = threading.Lock()
+        # host tier: data key -> (weakref(Data), nbytes) for pages the
+        # device tier wrote back; pruned when re-staged or freed
+        self._host: dict[Any, tuple[Any, int]] = {}
+        # peer tier: data key -> (rwire, version, nbytes) for pages
+        # whose bytes live on the attached peer rank; _spill_ref holds
+        # the Data weakly between spill-send and ACK (local bytes drop
+        # only once the peer confirms custody)
+        self._peer: dict[Any, tuple[tuple, int, int]] = {}
+        self._spill_ref: dict[Any, Any] = {}
+        self._issued: set = set()    # peer GETs in flight (keys)
+        self._engine = None
+        self._peer_rank: int | None = None
+        self.prefetch_inflight = 0    # issued, not yet landed/confirmed
+        self.prefetched_pages = 0
+        self.spills = 0               # device -> host write-backs seen
+        self.peer_spills = 0
+        self.peer_fetches = 0
+        from ..device.tpu import register_spill_hook
+        register_spill_hook(self)
+
+    # -- the device eviction hook ----------------------------------------
+    def note_spill(self, data: Any, nbytes: int) -> None:
+        """Called (weakly) by the device module after every eviction
+        write-back; filters to this map's collection."""
+        if getattr(data, "dc", None) is not self.kv:
+            return
+        with self._lock:
+            self._host[data.key] = (weakref.ref(data), int(nbytes))
+            self.spills += 1
+        self._maybe_spill_to_peer()
+
+    def _host_pages_locked(self) -> list[tuple[Any, Any, int]]:
+        """Live, still host-resident-only entries; prunes the rest."""
+        out, dead = [], []
+        for key, (ref, nb) in self._host.items():
+            d = ref()
+            if d is None:
+                dead.append(key)
+                continue
+            host = d.get_copy(0)
+            if host is None or host.value is None \
+                    or host.coherency == COHERENCY_INVALID:
+                dead.append(key)      # freed, recycled, or peer-spilled
+                continue
+            with d._lock:
+                restaged = any(i != 0
+                               and c.coherency != COHERENCY_INVALID
+                               for i, c in d.device_copies.items())
+            if restaged:
+                dead.append(key)      # back in the device tier
+                continue
+            out.append((key, d, nb))
+        for key in dead:
+            self._host.pop(key, None)
+        return out
+
+    @property
+    def host_tier_bytes(self) -> int:
+        with self._lock:
+            return sum(nb for _, _, nb in self._host_pages_locked())
+
+    # -- device prefetch (the return path) -------------------------------
+    def _device(self):
+        from ..device.device import registry
+        for dev in registry.by_type("tpu"):
+            if dev.enabled and hasattr(dev, "prefetch_data"):
+                return dev
+        return None
+
+    def prefetch_seqs(self, seqs: Sequence[Any]) -> int:
+        """Stage the listed sequences' non-resident pages back toward
+        the device tier, one superpool ahead of the decode wavefront.
+        Peer-tier pages are pulled home first (async GETs); host-tier
+        pages move in one batched async ``device_put``.  Returns the
+        number of pages staged device-ward."""
+        with self._lock:
+            if not self._host and not self._peer:
+                return 0      # nothing ever spilled: stay off the hot path
+        self._pull_peer_pages(seqs)
+        dev = self._device()
+        if dev is None:
+            return 0
+        datas = []
+        for seq in seqs:
+            try:
+                table = self.kv.block_table(seq)
+            except KeyError:
+                continue               # retired between submit and here
+            for page in range(len(table)):
+                d = self.kv.data_of(seq, page)
+                # count only pages that actually need staging, or the
+                # inflight gauge would spike to the whole working set
+                # while the device skips everything (phantom pressure)
+                host = d.get_copy(0)
+                if host is None or host.value is None \
+                        or host.coherency == COHERENCY_INVALID:
+                    continue
+                cur = d.get_copy(dev.device_index)
+                if cur is not None and cur.version >= host.version \
+                        and cur.coherency != COHERENCY_INVALID:
+                    continue
+                datas.append(d)
+        if not datas:
+            return 0
+        with self._lock:
+            self.prefetch_inflight += len(datas)
+        try:
+            n = dev.prefetch_data(datas)
+        finally:
+            with self._lock:
+                self.prefetch_inflight -= len(datas)
+        with self._lock:
+            self.prefetched_pages += n
+        return n
+
+    # -- peer tier --------------------------------------------------------
+    def attach_peer(self, engine: Any, peer_rank: int) -> None:
+        """Wire a comm engine: cold host-tier pages past the
+        ``kv_host_tier_bytes`` budget spill to ``peer_rank`` (which must
+        run a :class:`PeerKVStore` on its engine), and prefetch pulls
+        them back over the fragmented GET path."""
+        self._engine = engine
+        self._peer_rank = int(peer_rank)
+        engine.tag_register(AM_TAG_KV_SPILL_ACK, self._on_spill_ack)
+
+    def _maybe_spill_to_peer(self) -> None:
+        budget = _params.get("kv_host_tier_bytes")
+        if not budget or self._engine is None:
+            return
+        with self._lock:
+            pages = self._host_pages_locked()
+            total = sum(nb for _, _, nb in pages)
+            victims = []
+            for key, d, nb in pages:        # insertion order = coldest
+                if total <= budget:
+                    break
+                victims.append((key, d, nb))
+                total -= nb
+            for key, _, _ in victims:
+                self._host.pop(key, None)
+        for key, d, nb in victims:
+            self._spill_page_to_peer(key, d, nb)
+
+    def _spill_page_to_peer(self, key: Any, d: Any, nb: int) -> None:
+        host = d.get_copy(0)
+        if host is None or host.value is None:
+            return
+        value = np.asarray(host.value)
+        with self._lock:
+            # rwire arrives with the ACK; version/nbytes recorded now so
+            # the restore path can validate staleness.  The host bytes
+            # are NOT dropped yet: until the peer acknowledges custody,
+            # this copy is the only one in existence — a lost AM must
+            # degrade to "page stayed local", never to "page gone".
+            self._peer[key] = (None, int(host.version), int(value.nbytes))
+            self._spill_ref[key] = weakref.ref(d)
+            self.peer_spills += 1
+        self._engine.send_am(AM_TAG_KV_SPILL, self._peer_rank,
+                             {"key": key, "version": int(host.version),
+                              "reply_to": self._engine.rank,
+                              "value": np.array(value, copy=True)})
+
+    def _on_spill_ack(self, eng: Any, src: int, msg: dict) -> None:
+        key = msg["key"]
+        with self._lock:
+            ent = self._peer.get(key)
+            ref = self._spill_ref.pop(key, None)
+            if ent is None:
+                return
+            self._peer[key] = (tuple(msg["rwire"]), ent[1], ent[2])
+        # the peer holds the bytes now: release the local copy (the
+        # tier point — host memory decouples from live-page count).
+        # A page that was re-staged AND re-written since the spill has
+        # advanced past the recorded version: its peer replica is stale,
+        # so drop the peer entry instead and drain the handle.
+        d = ref() if ref is not None else None
+        stale = True
+        if d is not None:
+            with d._lock:
+                host = d.device_copies.get(0)
+                if host is not None and host.value is not None \
+                        and host.version == ent[1]:
+                    host.value = None
+                    host.coherency = COHERENCY_INVALID
+                    stale = False
+        if stale:
+            with self._lock:
+                rwire = self._peer.pop(key, (None,))[0]
+            if rwire is not None:
+                self._engine.get(rwire, lambda _v: None)   # consume it
+
+    def _pull_peer_pages(self, seqs: Sequence[Any],
+                         drain_timeout: float = 30.0) -> int:
+        """Pull the listed sequences' peer-resident pages home.  The
+        peer address stays in ``_peer`` until the bytes actually LAND
+        (``_land`` pops it), so a transfer that dies mid-flight leaves
+        the page addressable for a retry instead of lost; ``_issued``
+        dedups concurrent pulls.  Before returning, the engine is
+        progressed until every issued GET landed (bounded): the caller
+        is about to dispatch a superpool that READS these pages, and a
+        page whose only copy is still remote would crash its task —
+        peer-tier re-entry is a bounded stall, the *host*-tier return
+        path is the overlapped one."""
+        if self._engine is None or not self._peer:
+            return 0
+        keys = set()
+        for seq in seqs:
+            try:
+                for phys in self.kv.block_table(seq):
+                    keys.add((self.kv.name, phys))
+            except KeyError:
+                continue
+        issued = 0
+        for key in keys:
+            with self._lock:
+                ent = self._peer.get(key)
+                if ent is None or ent[0] is None \
+                        or key in self._issued:
+                    continue          # local, ACK-pending, or already out
+                rwire, version, nb = ent
+                self._issued.add(key)
+                self.prefetch_inflight += 1
+            try:
+                self._engine.prefetch_get(
+                    rwire,
+                    lambda v, _k=key, _v=version: self._land(_k, _v, v))
+            except Exception:         # noqa: BLE001 — a failed issue is
+                with self._lock:      # a non-event: the address survives
+                    self._issued.discard(key)
+                    self.prefetch_inflight -= 1
+                continue
+            issued += 1
+        if issued:
+            # progress every engine reachable on the fabric (in-process
+            # tiers the peer lives in this process and must serve); a
+            # socket tier's peer progresses itself
+            fab = getattr(self._engine, "fabric", None)
+            engines = [e for e in getattr(fab, "engines", [])
+                       if e is not None] or [self._engine]
+            deadline = time.monotonic() + drain_timeout
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not (self._issued & keys):
+                        break
+                for e in engines:
+                    e.progress()
+                time.sleep(0.0002)
+            else:
+                # abandoned transfers: release their inflight counts so
+                # the gauge cannot leak; a late _land still restores the
+                # bytes (it no longer finds the key in _issued)
+                with self._lock:
+                    for key in list(self._issued & keys):
+                        self._issued.discard(key)
+                        self.prefetch_inflight -= 1
+        return issued
+
+    def _land(self, key: Any, version: int, value: Any) -> None:
+        with self._lock:
+            if key in self._issued:
+                self._issued.discard(key)
+                self.prefetch_inflight -= 1
+            self._peer.pop(key, None)   # home again: address retired
+            self.peer_fetches += 1
+        # restore the host copy; the device prefetch picks it up from
+        # here like any other host-tier page
+        phys = key[1]
+        with self.kv._lock:
+            d = self.kv._pages.get(phys)
+        if d is None:
+            return                      # page freed while remote
+        with d._lock:
+            host = d.device_copies.get(0)
+            if host is None or host.version > version:
+                return                  # recycled to a new tenant: stale
+            host.value = np.asarray(value)
+            host.version = version
+            host.coherency = COHERENCY_SHARED
+
+    # -- introspection ----------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            host_pages = self._host_pages_locked()
+            return {
+                "host_tier_pages": len(host_pages),
+                "host_tier_bytes": sum(nb for _, _, nb in host_pages),
+                "peer_tier_pages": len(self._peer),
+                "peer_tier_bytes": sum(e[2] for e in self._peer.values()),
+                "prefetch_inflight": self.prefetch_inflight,
+                "prefetched_pages": self.prefetched_pages,
+                "spills": self.spills,
+                "peer_spills": self.peer_spills,
+                "peer_fetches": self.peer_fetches,
+            }
+
+
+class PeerKVStore:
+    """The serving side of the peer tier: pins spilled pages under
+    registered mem handles so the owner can pull them back with a
+    (fragmented, credit-windowed) GET.  One per engine on the rank that
+    donates its host memory."""
+
+    def __init__(self, engine: Any) -> None:
+        self.engine = engine
+        self._lock = threading.Lock()
+        self._held: dict[tuple[int, Any], Any] = {}   # (src, key) -> handle
+        self.pages_held = 0
+        self.bytes_held = 0
+        engine.tag_register(AM_TAG_KV_SPILL, self._on_spill)
+
+    def _on_spill(self, eng: Any, src: int, msg: dict) -> None:
+        value = np.asarray(msg["value"])
+        hkey = (msg["reply_to"], msg["key"])
+
+        def drained(_hkey=hkey, _nb=value.nbytes) -> None:
+            with self._lock:
+                self._held.pop(_hkey, None)
+                self.pages_held -= 1
+                self.bytes_held -= _nb
+
+        # owned=True: the codec handed us our own buffer, no extra copy
+        h = self.engine.mem_register(value, refcount=1,
+                                     on_drained=drained, owned=True)
+        with self._lock:
+            self._held[hkey] = h
+            self.pages_held += 1
+            self.bytes_held += value.nbytes
+        self.engine.send_am(AM_TAG_KV_SPILL_ACK, msg["reply_to"],
+                            {"key": msg["key"], "rwire": h.wire()})
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"pages_held": self.pages_held,
+                    "bytes_held": self.bytes_held}

@@ -69,12 +69,19 @@ class PagedKVCollection(DataCollection):
         self._refs: dict[int, int] = {}          # phys id -> sharers
         self._free: list[int] = []               # recycled phys ids
         self._next_phys = 0
-        self._tables: dict[Any, list[int]] = {}  # seq -> [phys ids]
+        self._tables: dict[Any, list[int]] = {}  # phys ids per seq
         self._lens: dict[Any, int] = {}          # seq -> appended tokens
         # tallies (bench/docs surface them)
         self.pages_allocated = 0
         self.pages_recycled = 0
         self.cow_copies = 0
+        # the prefix-cache counters (llm/prefix_tree.py bumps them on
+        # every trie adoption) and the tier attach point
+        # (data_dist/kv_tiers.py sets .tier so stats() can answer
+        # host_tier_bytes / prefetch_inflight without a second surface)
+        self.prefix_hits = 0
+        self.prefix_pages_reused = 0
+        self.tier: Any = None
 
     # -- the DataCollection vtable --------------------------------------
     def rank_of(self, *key) -> int:
@@ -175,15 +182,43 @@ class PagedKVCollection(DataCollection):
             elif self._refs[table[page]] > 1:
                 # shared partial tail (post-fork): writes must not leak
                 # into the sibling — private copy, refcount handed back
-                old = table[page]
-                self._refs[old] -= 1
-                phys = self._new_page_locked()
-                src = self._pages[old].get_copy(0)
-                self._pages[phys].get_copy(0).value = \
-                    np.array(src.value, copy=True)
-                table[page] = phys
-                self.cow_copies += 1
+                self._privatize_locked(table, page)
             return page, slot
+
+    def _privatize_locked(self, table: list[int],
+                          page: int) -> int:  # lint: holds(_lock)
+        """Replace ``table[page]`` with a private copy of its bytes —
+        the CoW divergence point.  The copy sources the NEWEST live copy
+        of the shared page, not the host copy: with a device tier the
+        sibling's on-device writes (or an evicted-but-not-yet-written-
+        back victim in the w2r queue) run AHEAD of host, and copying the
+        host bytes would silently fork a stale snapshot.  The private
+        page's host version also jumps PAST every version the shared
+        page ever reached — the recycle-detach discipline of
+        ``_new_page_locked`` extended to the fork path, so no later
+        version comparison can ever prefer state inherited from the
+        shared ancestor."""
+        old = table[page]
+        old_d = self._pages[old]
+        src = old_d.newest_copy()
+        if src is None or src.value is None:
+            # every copy is gone (e.g. the page sits in the peer tier
+            # mid-roundtrip): privatizing would fork garbage — fail THIS
+            # stream loudly instead (the batcher contains it per stream)
+            raise RuntimeError(
+                f"{self.name}: page {old} has no live copy to privatize "
+                f"from (spilled beyond the host tier?)")
+        self._refs[old] -= 1
+        phys = self._new_page_locked()
+        with old_d._lock:
+            maxv = max((c.version for c in old_d.device_copies.values()),
+                       default=0)
+        dst = self._pages[phys].get_copy(0)
+        dst.value = np.array(np.asarray(src.value), copy=True)
+        dst.version = max(dst.version, maxv) + 1
+        table[page] = phys
+        self.cow_copies += 1
+        return phys
 
     def note_appended(self, seq: Any, n: int = 1) -> None:
         """Advance host-side bookkeeping after ``n`` tokens' K/V landed in
@@ -206,6 +241,38 @@ class PagedKVCollection(DataCollection):
                 self._refs[phys] += 1
             self._tables[child] = table
             self._lens[child] = self._lens[parent]
+
+    def fork_prefix(self, parent: Any, child: Any, pages: int) -> None:
+        """Prefix fork: the child shares only the parent's first
+        ``pages`` pages (refcount++) and its length ledger starts at the
+        page boundary ``pages * page_size`` — the trie-adoption seam
+        (``llm/prefix_tree.py``): an incoming prompt that matches a
+        retained prefix forks exactly the matched FULL pages and
+        prefills only its unmatched tail.  Only whole pages are ever
+        shared, so a prefix fork never creates a shared partial tail —
+        divergence happens in fresh private pages, not through
+        :meth:`ensure_tail_slot` CoW."""
+        with self._lock:
+            if child in self._tables:
+                raise KeyError(f"sequence {child!r} already allocated")
+            table = self._tables[parent]
+            if not 0 <= pages <= len(table):
+                raise ValueError(
+                    f"prefix fork of {pages} pages from {parent!r} "
+                    f"({len(table)} pages)")
+            if pages * self.page_size > self._lens[parent]:
+                raise ValueError(
+                    f"prefix fork of {pages} pages exceeds {parent!r}'s "
+                    f"{self._lens[parent]}-token ledger (partial page)")
+            shared = table[:pages]
+            for phys in shared:
+                self._refs[phys] += 1
+            self._tables[child] = list(shared)
+            self._lens[child] = pages * self.page_size
+
+    def has_seq(self, seq: Any) -> bool:
+        with self._lock:
+            return seq in self._tables
 
     def free_seq(self, seq: Any) -> int:
         """Release a sequence; pages drop to the free list when their
@@ -265,4 +332,14 @@ class PagedKVCollection(DataCollection):
                 "pages_allocated": self.pages_allocated,
                 "pages_recycled": self.pages_recycled,
                 "cow_copies": self.cow_copies,
+                # prefix-cache effectiveness + tier residency: every
+                # consumer of stats() (bench llm emit, runtime_report's
+                # llm block, the serve soak asserts) reads cache wins
+                # and spill pressure off the SAME dict
+                "prefix_hits": self.prefix_hits,
+                "prefix_pages_reused": self.prefix_pages_reused,
+                "host_tier_bytes": (self.tier.host_tier_bytes
+                                    if self.tier is not None else 0),
+                "prefetch_inflight": (self.tier.prefetch_inflight
+                                      if self.tier is not None else 0),
             }

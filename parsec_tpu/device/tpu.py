@@ -57,6 +57,39 @@ def _copy_nbytes(copy: DataCopy) -> int:
     return getattr(copy.value, "nbytes", 0) if copy.value is not None else 0
 
 
+# --------------------------------------------------------------------------
+# tier spill hooks (ISSUE 11): the KV tier map (data_dist/kv_tiers.py)
+# subscribes to device evictions so HBM -> host write-backs of its pages
+# feed the host-tier residency ledger.  Weakly held — a dropped tier map
+# must not be pinned by the device module for the process lifetime.
+# --------------------------------------------------------------------------
+import weakref as _weakref
+
+_spill_hooks: list = []       # weakrefs to objects with .note_spill(d, nb)
+
+
+def register_spill_hook(obj: Any) -> None:
+    """Subscribe ``obj.note_spill(data, nbytes)`` to every device-tier
+    eviction write-back.  Held by weakref; dead subscribers prune on
+    the next fire."""
+    _spill_hooks.append(_weakref.ref(obj))
+
+
+def _fire_spill(data: Any, nbytes: int) -> None:
+    dead = False
+    for ref in _spill_hooks:
+        obj = ref()
+        if obj is None:
+            dead = True
+            continue
+        try:
+            obj.note_spill(data, nbytes)
+        except Exception:       # noqa: BLE001 — accounting never faults I/O
+            pass
+    if dead:
+        _spill_hooks[:] = [r for r in _spill_hooks if r() is not None]
+
+
 class TPUDeviceTask:
     """Device task descriptor (cf. ``parsec_gpu_task_t``, device_gpu.h:79-121)."""
 
@@ -260,15 +293,22 @@ class TPUDevice(Device):
             self.bytes_out += value.nbytes
         d.detach_copy(self.device_index)
         copy.coherency = COHERENCY_INVALID
+        if _spill_hooks:
+            # the datum is host-resident-only now: tier maps account it
+            _fire_spill(d, _copy_nbytes(copy))
 
     def flush_cache(self) -> None:
         """Synchronize every dirty tile back to its host copy (epilog for a
-        taskpool; the data_flush analog for device residency)."""
+        taskpool; the data_flush analog for device residency).  Write-back
+        happens OUTSIDE the LRU lock: spill hooks may copy page bytes and
+        push AMs (kv_tiers peer spill), and concurrent stage-ins must not
+        serialize behind that I/O."""
         self._drain_evictions()   # pending w2r victims are not in the LRU
         with self._lru_lock:
-            for k in list(self._mem_lru):
-                self._writeback(self._mem_lru.pop(k))
+            victims = [self._mem_lru.pop(k) for k in list(self._mem_lru)]
             self._mem_bytes = 0
+        for c in victims:
+            self._writeback(c)
 
     # ----------------------------------------------------------- stage-in
     def stage_in(self, task: Any) -> None:
@@ -349,6 +389,86 @@ class TPUDevice(Device):
             # every assigned key was ensured in `missing` and every miss
             # lands above — a KeyError here is a real landing bug
             task.data[fi] = landed[k]
+
+    def prefetch_data(self, datas: list[Any]) -> int:
+        """Data-grain prefetch (ISSUE 11): stage host-resident datums
+        back into the device tier AHEAD of the tasks that will read
+        them — the KV tier map calls this one decode superpool ahead of
+        the wavefront, so a paged-out stream re-enters decode without a
+        synchronous stage-in stall.  Advisory and idempotent: datums
+        with a current device copy are skipped, everything else moves
+        in one async ``jax.device_put`` that overlaps whatever the
+        manager is dispatching; a racing stage-in of the same datum
+        lands identical bytes at the same version.  Unlike the queue
+        lookahead (``_prefetch_upcoming``), this MAY evict: the caller
+        asserts the datums are the next wavefront's inputs, so trading
+        colder residents for them is the point of the call — but each
+        call stages at most HALF the byte budget, leaving the in-flight
+        batch room to keep its own tiles (an HBM budget below the
+        working set then pays one overlapped transfer sweep per
+        iteration instead of degenerating into prefetch-vs-dispatch
+        thrash).  Returns the number of datums staged."""
+        import jax
+        cap = self._mem_budget // 2
+        todo: list[tuple[Any, DataCopy, int, Any]] = []
+        for d in datas:
+            host = d.get_copy(0)
+            if host is None or host.value is None \
+                    or host.coherency == COHERENCY_INVALID:
+                continue
+            dev = d.get_copy(self.device_index)
+            if dev is not None and dev.version >= host.version \
+                    and dev.coherency != COHERENCY_INVALID:
+                continue
+            nb = getattr(host.value, "nbytes", 0)
+            if nb > cap:
+                break                 # the half-budget sweep is full
+            cap -= nb
+            # version and value snapshot TOGETHER: the landed copy is
+            # tagged with the version of the bytes that actually moved,
+            # never the (possibly advanced-meanwhile) live host version
+            todo.append((d, host, host.version, host.value))
+        if not todo:
+            return 0
+        import time as _time
+        t0 = _time.perf_counter()
+        values = jax.device_put([v for _, _, _, v in todo],
+                                self.jax_device)
+        nb_total = 0
+        staged = 0
+        for (d, host, snap_ver, _sv), value in zip(todo, values):
+            with d._lock:
+                dev = d.device_copies.get(self.device_index)
+                if dev is not None and (
+                        dev.coherency in (COHERENCY_OWNED,
+                                          COHERENCY_EXCLUSIVE)
+                        or (dev.version >= snap_ver
+                            and dev.coherency != COHERENCY_INVALID)):
+                    # a dispatch staged or wrote it meanwhile: a dirty
+                    # device copy runs AHEAD of host and must never be
+                    # clobbered with the (older) snapshot bytes
+                    continue
+                if dev is None:
+                    dev = DataCopy(d, self.device_index, value=value,
+                                   dtt=host.dtt)
+                    d.device_copies[self.device_index] = dev
+                else:
+                    dev.value = value
+                # a host write-back that landed AFTER the snapshot makes
+                # this copy stale at birth: tagging it with snap_ver (not
+                # the live host version) makes the next stage_in see the
+                # miss and re-stage current bytes
+                dev.version = snap_ver
+                dev.coherency = COHERENCY_SHARED
+            nb = getattr(_sv, "nbytes", 0)
+            self.bytes_in += nb
+            nb_total += nb
+            staged += 1
+            self._cache_insert(d.key, dev, nb)
+        self.t_stage_in += _time.perf_counter() - t0
+        if nb_total:
+            pins.fire(PinsEvent.DEVICE_STAGE_IN, None, int(nb_total))
+        return staged
 
     # ------------------------------------------------- the manager protocol
     def kernel_scheduler(self, es: Any, task: Any, submit: Callable) -> int:

@@ -43,6 +43,7 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Any, Sequence
 
@@ -53,11 +54,13 @@ from ..core.params import params as _params
 from ..data.datatype import TileType
 from ..prof import spans as _spans
 from ..data_dist.collection import DictCollection
+from ..data_dist.kv_tiers import KVTierMap
 from ..data_dist.paged_kv import PagedKVCollection
 from .decode import (decode_superpool_ptg, preallocate_decode_steps,
                      prefill_chunks, prefill_ptg, read_token_chain,
                      seed_emb_table, seed_stream_step)
 from .model import ToyLM
+from .prefix_tree import PrefixTree
 
 _params.register("llm_page_size", 16,
                  "tokens per KV page (PagedKVCollection block size)")
@@ -89,6 +92,53 @@ _params.register("llm_lower_regions", False,
                  "verified region (compile cost rides the lowering "
                  "cache / AOT warming; pools that cannot lower fall "
                  "back to the dynamic path)")
+_params.register("llm_prefetch_ahead", True,
+                 "stage live streams' device-evicted KV pages back in "
+                 "one superpool ahead of the decode wavefront (the "
+                 "kv_tiers.KVTierMap return path): the async device_put "
+                 "overlaps the in-flight superpools, so an HBM budget "
+                 "below the working set costs bandwidth, not stalls")
+
+# live batchers, weakly held: runtime_report()["llm"] aggregates their
+# cache/tier effectiveness without pinning a stopped batcher (or
+# importing this module when no LLM workload ever ran).  A stopping
+# batcher folds its final counters into _retired_totals so the report
+# stays cumulative-since-process-start like every other block (a bench
+# stage's drained servers still show up in the post-stage report).
+_live_batchers: "weakref.WeakSet[ContinuousBatcher]" = weakref.WeakSet()
+_retired_totals: dict[str, int] = {}
+_retired_lock = threading.Lock()
+
+_REPORT_KEYS = ("tokens_generated", "streams_completed", "decode_submits",
+                "forked_streams", "prefill_tokens_total",
+                "prefill_tokens_skipped")
+_REPORT_KV_KEYS = ("prefix_hits", "prefix_pages_reused", "host_tier_bytes",
+                   "prefetch_inflight", "physical_pages", "cow_copies")
+
+
+def _fold_stats(out: dict, s: dict) -> None:
+    for k in _REPORT_KEYS:
+        out[k] = out.get(k, 0) + s.get(k, 0)
+    for k in _REPORT_KV_KEYS:
+        out[k] = out.get(k, 0) + s.get("kv", {}).get(k, 0)
+
+
+def aggregate_report() -> dict:
+    """The ``llm`` block of ``prof.runtime_report()``: counters summed
+    across every live batcher plus the folded totals of retired ones —
+    present in a report only when this module is already imported AND
+    an LLM workload actually ran."""
+    with _retired_lock:
+        out: dict[str, Any] = dict(_retired_totals)
+    for b in list(_live_batchers):
+        if not getattr(b, "_folded", False):
+            _fold_stats(out, b.stats())
+    if out:
+        total = out.get("prefill_tokens_total", 0)
+        out["prefill_skipped_frac"] = round(
+            out.get("prefill_tokens_skipped", 0) / total, 4) if total \
+            else 0.0
+    return out
 
 
 class StreamTicket:
@@ -104,6 +154,8 @@ class StreamTicket:
         self.tokens: list[int] = []
         self.per_token_s: list[float] = []
         self.prefill_s: float | None = None
+        self.first_token_at: float | None = None   # monotonic TTFT stamp
+        self.prefix_pages_reused = 0   # trie pages this stream skipped
         # the stream's trace context (prof/spans.py): the request-scoped
         # identity of this generation, named by stall dumps and carried
         # by every decode superpool ticket the stream rides
@@ -188,6 +240,16 @@ class ContinuousBatcher:
         seed_emb_table(self.model, self.EMB)
         self.max_batch = max_batch or _params.get("llm_max_batch")
         self.devices = devices
+        # the ISSUE-11 memory hierarchy: an automatic prefix cache over
+        # the KV collection (llm_prefix_cache — retired streams donate
+        # their prompt pages, arrivals fork the longest retained
+        # prefix), and a tier map accounting device-evicted pages +
+        # staging them back ahead of the wavefront
+        self.prefix = (PrefixTree(self.kv)
+                       if _params.get("llm_prefix_cache") else None)
+        self.tiers = KVTierMap(self.kv)
+        self.prefill_tokens_total = 0     # cacheable tokens admitted
+        self.prefill_tokens_skipped = 0   # of those, served by the trie
         # the server's per-tenant SLO plane (prof/histogram.py): TTFT +
         # inter-token latency land there, so RuntimeServer.metrics()
         # answers "what are my per-tenant token p99s" live mid-run
@@ -205,6 +267,7 @@ class ContinuousBatcher:
         self.decode_submits = 0         # superpool submits (1/k per token)
         self.forked_streams = 0         # streams whose prompt KV forked
         self._pool_seq = itertools.count()
+        _live_batchers.add(self)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="llm-batcher")
         self._thread.start()
@@ -264,7 +327,7 @@ class ContinuousBatcher:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "live_streams": len(self._live),
                 "queued_streams": len(self._pending),
                 "steps": self.steps,
@@ -272,8 +335,14 @@ class ContinuousBatcher:
                 "streams_completed": self.streams_completed,
                 "decode_submits": self.decode_submits,
                 "forked_streams": self.forked_streams,
-                "kv": self.kv.stats(),
+                "prefill_tokens_total": self.prefill_tokens_total,
+                "prefill_tokens_skipped": self.prefill_tokens_skipped,
             }
+        out["kv"] = self.kv.stats()
+        out["tiers"] = self.tiers.stats()
+        if self.prefix is not None:
+            out["prefix"] = self.prefix.stats()
+        return out
 
     def stop(self, timeout: float | None = 60.0) -> None:
         """Graceful: no new streams, finish the live ones, join.  On
@@ -286,6 +355,13 @@ class ContinuousBatcher:
             self._abort = RuntimeError("batcher stop timed out")
             self._wake.set()
             self._thread.join(5.0)
+        # fold the final counters into the process aggregate exactly
+        # once, so runtime_report()["llm"] stays cumulative after this
+        # batcher (and its server) are gone
+        with _retired_lock:
+            if not getattr(self, "_folded", False):
+                self._folded = True
+                _fold_stats(_retired_totals, self.stats())
 
     # -- the iteration loop ---------------------------------------------
     def _loop(self) -> None:
@@ -388,6 +464,33 @@ class ContinuousBatcher:
         except KeyError:                 # parent retired / never admitted
             return False
 
+    def _admit_via_prefix(self, st: _Stream) -> int:
+        """Materialize a fresh stream's sequence, through the prefix
+        cache when enabled: the trie matches ``prompt[:-1]`` (the
+        cacheable run) and forks the longest retained full-page prefix
+        copy-on-write (``PagedKVCollection.fork_prefix``), so only the
+        unmatched tail prefills.  Returns the number of pages reused
+        (0 = miss or cache disabled — plain ``alloc_seq``)."""
+        if self.prefix is None:
+            self.kv.alloc_seq(st.seq)
+            reused = 0
+        else:
+            reused = self.prefix.adopt(st.seq, st.prompt[:-1])
+        cacheable = len(st.prompt) - 1
+        skipped = reused * self.kv.page_size
+        with self._lock:
+            self.prefill_tokens_total += cacheable
+            self.prefill_tokens_skipped += skipped
+        if reused:
+            st.ticket.prefix_pages_reused = reused
+            if self._slo is not None:
+                # the per-tenant cache-effectiveness counters (PR-10
+                # SLO plane): operators read hit rates next to the TTFT
+                # quantiles the hits are supposed to move
+                self._slo.inc(st.tenant, "prefix_hits")
+                self._slo.inc(st.tenant, "prefix_pages_reused", reused)
+        return reused
+
     def _prefill_submit(self, fresh: list[_Stream]) -> dict:
         """Phase 1 of the chunked-prefill interleave: allocate pages and
         SUBMIT one PF pool per tenant, without awaiting — the caller
@@ -397,6 +500,7 @@ class ContinuousBatcher:
         children skip prefill entirely and resolve in
         :meth:`_prefill_await` once their parent's pages are real."""
         stream_chunks: dict[Any, dict[tuple, np.ndarray]] = {}
+        chunk_starts: dict[Any, int] = {}
         by_tenant: dict[str, list[_Stream]] = {}
         forks: list[_Stream] = []
         fresh_ids = {id(st) for st in fresh}
@@ -409,9 +513,14 @@ class ContinuousBatcher:
                 continue
             st.fork_from = None          # parent advanced: plain prefill
             try:
-                self.kv.alloc_seq(st.seq)
+                reused = self._admit_via_prefix(st)
+                # tail-only prefill: chunk indices continue past the
+                # trie-shared pages (prefill_chunks reads the page
+                # count); a full-prefix hit leaves nothing to chunk
                 stream_chunks[st.seq] = prefill_chunks(
-                    self.model, self.kv, st.seq, st.prompt[:-1])
+                    self.model, self.kv, st.seq,
+                    st.prompt[reused * self.kv.page_size:-1])
+                chunk_starts[st.seq] = reused
             except BaseException as e:       # noqa: BLE001 — contain
                 self._retire_failed([st], e)
                 continue
@@ -422,9 +531,15 @@ class ContinuousBatcher:
         ok: list[_Stream] = []
         done_t: dict[int, float] = {}
         for tenant, group in by_tenant.items():
-            seqs = [st.seq for st in group if self.kv.npages(st.seq) > 0]
+            # only streams with tail chunks ride a PF pool: single-token
+            # prompts cache nothing, and a FULL-prefix trie hit already
+            # holds every cacheable page copy-on-write — both join the
+            # batch with prefill_s = 0.0 instead of awaiting a pool
+            ok.extend(st for st in group
+                      if not stream_chunks.get(st.seq))
+            group = [st for st in group if stream_chunks.get(st.seq)]
+            seqs = [st.seq for st in group]
             if not seqs:
-                ok.extend(group)  # single-token prompts cache nothing
                 continue
             # THIS group's chunks only: the T key space is what lowering
             # and operators may walk, so it must not declare other
@@ -439,7 +554,9 @@ class ContinuousBatcher:
                     init_fn=lambda *k, _c=chunks: _c[k],
                     keys=list(chunks))
                 tp = prefill_ptg(self.kv, T, seqs, devices=self.devices,
-                                 name=f"llm_prefill{next(self._pool_seq)}")
+                                 name=f"llm_prefill{next(self._pool_seq)}",
+                                 starts=[chunk_starts.get(s, 0)
+                                         for s in seqs])
                 # timestamp the pool's ACTUAL completion: the interleave
                 # awaits only after the decode superpools, so awaiting
                 # time would inflate prefill_s by a whole iteration
@@ -552,6 +669,22 @@ class ContinuousBatcher:
         stream (slot allocation) or per tenant (pool shed/failure) —
         the rest of the batch decodes on."""
         k_max = max(1, int(_params.get("llm_steps_per_pool")))
+        if _params.get("llm_prefetch_ahead"):
+            # the tier return path, ahead of the decode wavefront: pages
+            # the PREVIOUS iteration's eviction pressure pushed to the
+            # host tier stage back in asynchronously while this thread
+            # does host-side prep (slot preallocation, seeding, pool
+            # build) — an HBM budget below the working set costs
+            # overlapped bandwidth instead of synchronous stage-in
+            # stalls when the superpool dispatches.  Advisory: a
+            # prefetch failure must never fail the batch (on-demand
+            # stage-in still serves every page).
+            try:
+                n = self.tiers.prefetch_seqs([st.seq for st in live])
+            except Exception:                # noqa: BLE001 — contain
+                n = 0
+            if n and self._slo is not None:
+                self._slo.inc("_server", "kv_prefetched_pages", n)
         ready: list[_Stream] = []
         for st in live:
             k = max(1, min(k_max, st.max_new - len(st.ticket.tokens)))
@@ -607,15 +740,18 @@ class ContinuousBatcher:
                 # not appends), and a done stream's pages free anyway
                 self.kv.note_appended(st.seq, st.k)
                 st.cur = toks[-1]
-                if self._slo is not None and toks:
-                    # the stream's first token closes its TTFT; every
-                    # token samples the inter-token latency (this
-                    # iteration's wall amortized over its k tokens)
-                    if not st.ticket.tokens:
+                if toks and not st.ticket.tokens:
+                    # the stream's first token closes its TTFT (the
+                    # stamp is what the bench prefix sweep quantiles)
+                    st.ticket.first_token_at = time.monotonic()
+                    if self._slo is not None:
                         self._slo.observe(
                             st.tenant, "ttft_ms",
-                            (time.monotonic()
+                            (st.ticket.first_token_at
                              - st.ticket.submitted_at) * 1e3)
+                if self._slo is not None and toks:
+                    # every token samples the inter-token latency (this
+                    # iteration's wall amortized over its k tokens)
                     tok_ms = dt / len(toks) * 1e3
                     for _ in toks:
                         self._slo.observe(st.tenant, "tok_latency_ms",
@@ -632,5 +768,16 @@ class ContinuousBatcher:
                 self._live.remove(st)
                 self.streams_completed += 1
         for st in finished:
+            if self.prefix is not None:
+                # donate the prompt pages BEFORE free_seq: the trie's
+                # retained fork (refcount++) is what keeps them out of
+                # the recycle path.  Only cleanly-finished streams
+                # donate — a failed stream's pages may be zombie-written
+                # (and never reach this loop).  Donation is an
+                # optimization: its failure must never fail the stream.
+                try:
+                    self.prefix.donate(st.seq, st.prompt)
+                except Exception:        # noqa: BLE001 — contain
+                    pass
             self._release_stream_state(st.seq)
             st.ticket._resolve()

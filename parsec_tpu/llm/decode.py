@@ -42,16 +42,28 @@ from ..ops import ragged_attention as ra
 
 def prefill_ptg(kv: PagedKVCollection, T: DictCollection,
                 seqs: Sequence[Any], devices: str = "cpu",
-                name: str = "llm_prefill") -> ptg.PTGTaskpool:
+                name: str = "llm_prefill",
+                starts: Sequence[int] | None = None) -> ptg.PTGTaskpool:
     """PF(s, c) over every allocated page of every listed sequence.
     ``T`` holds the prompt chunk tiles, keyed ``(seq, chunk)``, in the
-    same ``(3, page_size, H, D)`` layout as the pages."""
+    same ``(3, page_size, H, D)`` layout as the pages.
+
+    ``starts[i]`` is sequence ``i``'s first chunk to fill — the
+    **tail-only prefill** shape (ISSUE 11): a stream admitted through
+    the prefix cache already shares its first ``starts[i]`` pages
+    copy-on-write with the trie, and the PF tasks must neither redo nor
+    overwrite them.  Default 0 everywhere = the full prefill."""
     NP = tuple(kv.npages(s) for s in seqs)
+    C0 = (tuple(0 for _ in seqs) if starts is None
+          else tuple(int(c) for c in starts))
+    if len(C0) != len(seqs) or any(not 0 <= c <= n
+                                   for c, n in zip(C0, NP)):
+        raise ValueError(f"starts {C0} out of range for page counts {NP}")
     p = ptg.PTGBuilder(name, KV=kv, T=T, SEQS=tuple(seqs), NP=NP,
-                       NS=len(seqs))
+                       C0=C0, NS=len(seqs))
     t = p.task("PF",
                s=ptg.span(0, lambda g, l: g.NS - 1),
-               c=lambda g, l: range(g.NP[l.s]))
+               c=lambda g, l: range(g.C0[l.s], g.NP[l.s]))
     t.affinity("KV", lambda g, l: (g.SEQS[l.s], l.c))
     ft = t.flow("T", ptg.READ)
     ft.input(data=("T", lambda g, l: (g.SEQS[l.s], l.c)))
@@ -65,11 +77,12 @@ def prefill_ptg(kv: PagedKVCollection, T: DictCollection,
         kvw.value = np.array(chunk, copy=True)
         kvw.version += 1
 
-    t.body(body)
     if devices in ("auto", "tpu"):
-        # prefill is a straight page copy; stage-in + writeback through
-        # the device tier is all the work, so no dedicated TPU kernel
-        pass
+        t.body(device="tpu", dyld="llm_prefill_copy")
+    # the dyld names the traceable twin (ops/ragged_attention.py), so
+    # the pool lowers/warms (llm_prefill_tail) and the device tier can
+    # vmap-batch PF tasks; the CPU body stays the plain copy
+    t.body(body, dyld="llm_prefill_copy")
     return p.build()
 
 
@@ -387,20 +400,27 @@ def prefill_chunks(model: Any, kv: PagedKVCollection, seq: Any,
                    tokens: Sequence[int]) -> dict[tuple, np.ndarray]:
     """Host-side prefill prep: allocate ``seq``'s pages for ``tokens``
     and return the ``(seq, chunk) -> tile`` map the T collection serves.
-    Advances the length ledger — the PF tasks only move the bytes."""
+    Advances the length ledger — the PF tasks only move the bytes.
+
+    Chunk indices continue from the sequence's CURRENT page count, so a
+    prefix-cache adoptee (first ``m`` pages CoW-shared from the trie,
+    ledger at the page boundary) prefills only its unmatched tail:
+    ``tokens`` are then ``prompt[m * page_size:-1]`` and land in pages
+    ``m, m+1, ...`` — a fresh sequence starts at chunk 0 unchanged."""
     P = kv.page_size
     chunks: dict[tuple, np.ndarray] = {}
     n = len(tokens)
-    for c in range((n + P - 1) // P):
+    c0 = kv.npages(seq)
+    for j in range((n + P - 1) // P):
         kv.alloc_page(seq)
-        part = tokens[c * P:(c + 1) * P]
+        part = tokens[j * P:(j + 1) * P]
         tile = np.zeros(kv.default_dtt.shape, kv.dtype)
         for i, tok in enumerate(part):
             q3 = model.q3(tok)
             tile[0, i] = q3[1]
             tile[1, i] = q3[2]
         tile[META_CH, 0, 0, 0] = len(part)
-        chunks[(seq, c)] = tile
+        chunks[(seq, c0 + j)] = tile
     kv.note_appended(seq, n)
     return chunks
 

@@ -210,9 +210,20 @@ def _out_update_jnp(acc: Any, q3: Any, page: Any, o_scratch: Any) -> Any:
     return page, o.astype(jnp.float32)
 
 
+def _prefill_copy_jnp(chunk: Any, page: Any) -> Any:
+    """PF: the page's new contents ARE the prompt chunk tile.  Trivial
+    on purpose — registering it is what makes the prefill pool
+    lowerable/warmable (``llm_prefill_tail``, ISSUE 11) and lets the
+    device tier vmap-batch PF tasks like any other class."""
+    import jax.numpy as jnp
+    del page
+    return jnp.asarray(chunk)
+
+
 register_traceable("ragged_attn_page", _page_update_jnp)
 register_traceable("ragged_attn_out", _out_update_jnp)
 register_traceable("llm_sample", _sample_jnp)
+register_traceable("llm_prefill_copy", _prefill_copy_jnp)
 
 
 # ---------------------------------------------------------------------------
@@ -320,9 +331,22 @@ def _load_sample_body() -> Any:
     return body
 
 
+def _load_prefill_body() -> Any:
+    def body(es: Any, task: Any, device: Any) -> Any:
+        # flow order: T, KV (llm/decode.py prefill_ptg).  Device arrays
+        # are immutable, so aliasing the staged chunk tile is safe.
+        kvw = task.data[1]
+        kvw.value = task.data[0].value
+        kvw.version += 1
+        return kvw.value
+
+    return body
+
+
 register_lazy_kernel("ragged_attn_page", "tpu", _load_page_body)
 register_lazy_kernel("ragged_attn_out", "tpu", _load_out_body)
 register_lazy_kernel("llm_sample", "tpu", _load_sample_body)
+register_lazy_kernel("llm_prefill_copy", "tpu", _load_prefill_body)
 
 
 # CPU dyld entries (DTD bodies may name them; the PTG pools attach the
@@ -358,6 +382,13 @@ def _sample_body_cpu(es: Any, task: Any) -> None:
     qn.version += 1
 
 
+def _prefill_body_cpu(es: Any, task: Any) -> None:
+    kvw = task.data[1]
+    kvw.value = np.array(np.asarray(task.data[0].value), copy=True)
+    kvw.version += 1
+
+
 register_kernel("ragged_attn_page", "cpu", _page_body_cpu)
 register_kernel("ragged_attn_out", "cpu", _out_body_cpu)
 register_kernel("llm_sample", "cpu", _sample_body_cpu)
+register_kernel("llm_prefill_copy", "cpu", _prefill_body_cpu)

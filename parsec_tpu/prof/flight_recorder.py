@@ -496,6 +496,7 @@ def runtime_report(max_workers: int = 6) -> dict:
             "frag_bytes_received": vsums[PinsEvent.COMM_GET_FRAG_RECV],
             "gets_completed": counts[PinsEvent.COMM_GET_DONE],
             "get_bytes_landed": vsums[PinsEvent.COMM_GET_DONE],
+            "prefetch_gets": counts[PinsEvent.COMM_GET_PREFETCH],
         }
     if counts[PinsEvent.SERVE_SUBMIT]:
         # serving-layer lifecycle tallies (serve/server.py): present only
@@ -517,6 +518,15 @@ def runtime_report(max_workers: int = 6) -> dict:
     slo = _best_effort(_slo, default={})
     if slo:
         rep["slo"] = slo
+    # LLM serving-memory effectiveness (ISSUE 11): prefix-cache hits,
+    # pages reused, tier residency, prefetch depth — aggregated across
+    # live batchers.  Keyed off sys.modules so a run that never served
+    # an LLM stream neither imports the subsystem nor grows its report.
+    bmod = sys.modules.get("parsec_tpu.llm.batcher")
+    if bmod is not None:
+        llm = _best_effort(bmod.aggregate_report, default={})
+        if llm:
+            rep["llm"] = llm
     now = _now()
 
     def activity(ring: _Ring) -> int:

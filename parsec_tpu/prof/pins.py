@@ -89,6 +89,7 @@ class PinsEvent(IntEnum):
     COMM_GET_FRAG_SENT = 37        # payload: fragment bytes served
     COMM_GET_FRAG_RECV = 38        # payload: fragment bytes landed
     COMM_GET_DONE = 39             # payload: total bytes of a finished GET
+    COMM_GET_PREFETCH = 40         # payload: owner rank of a lookahead GET
 
 
 Callback = Callable[[Any, Any], None]   # (execution_stream_or_none, payload)

@@ -2146,8 +2146,39 @@ def _warm_workload(workload: str, n: int | None, nb: int | None):
         tp = decode_superpool_ptg(kv, Q, O, TOK, EMB, seqs,
                                   [ksteps] * nseqs, devices="auto")
         return tp, dict(nseqs=nseqs, steps=ksteps)
+    if workload == "llm_prefill_tail":
+        # the prefix-cache admission shape (ISSUE 11): streams whose
+        # prompt matched the radix trie prefill only their unmatched
+        # tail (prefill_ptg(starts=)), so the hot serving path compiles
+        # THIS pool geometry — warming it keeps trie-hit prefills from
+        # paying cold XLA at admission time.  n = sequences, nb = tail
+        # pages per sequence (on top of a fixed 4-page shared prefix).
+        from ..data.datatype import TileType
+        from ..data_dist.collection import DictCollection
+        from ..data_dist.paged_kv import PagedKVCollection
+        from ..llm.decode import prefill_ptg
+        nseqs, tail_pages = n or 8, nb or 2
+        prefix_pages = 4
+        kv = PagedKVCollection("KV", page_size=16)
+        seqs = [f"s{i}" for i in range(nseqs)]
+        tkeys = []
+        for s in seqs:
+            kv.alloc_seq(s)
+            for _ in range(prefix_pages + tail_pages):
+                kv.alloc_page(s)
+            kv.note_appended(s, (prefix_pages + tail_pages)
+                             * kv.page_size)
+            tkeys += [(s, c) for c in range(prefix_pages,
+                                            prefix_pages + tail_pages)]
+        T = DictCollection("T", dtt=kv.default_dtt, keys=tkeys,
+                           init_fn=lambda *k:
+                           np.zeros(kv.default_dtt.shape, np.float32))
+        tp = prefill_ptg(kv, T, seqs, devices="auto",
+                         starts=[prefix_pages] * nseqs)
+        return tp, dict(nseqs=nseqs, tail_pages=tail_pages)
     raise ValueError(f"unknown warm workload {workload!r} (gemm, "
-                     f"cholesky, lu, stencil, llm_decode, llm_decode_k)")
+                     f"cholesky, lu, stencil, llm_decode, llm_decode_k, "
+                     f"llm_prefill_tail)")
 
 
 def warm_cache(workload: str, n: int | None = None, nb: int | None = None,
@@ -2195,14 +2226,15 @@ def _main(argv: list[str] | None = None) -> int:
                     "budgets').")
     ap.add_argument("--warm", metavar="WORKLOAD", required=True,
                     help="gemm | cholesky | lu | stencil | llm_decode | "
-                         "llm_decode_k")
+                         "llm_decode_k | llm_prefill_tail")
     ap.add_argument("--n", type=int, default=None,
                     help="problem size (stencil: vector length; "
-                    "llm_decode/llm_decode_k: sequence count)")
+                    "llm_decode/llm_decode_k/llm_prefill_tail: "
+                    "sequence count)")
     ap.add_argument("--nb", type=int, default=None,
                     help="tile size (stencil: segment size; llm_decode: "
                     "pages per sequence; llm_decode_k: steps per "
-                    "superpool)")
+                    "superpool; llm_prefill_tail: tail pages)")
     ap.add_argument("--nt", type=int, default=None,
                     help="tile count (alternative to --n: n = nt * nb)")
     ap.add_argument("--modes", default="auto,region",

@@ -41,6 +41,11 @@ else
     python -m pytest tests/test_tracing.py -q \
         -k "allocation_free" -p no:cacheprovider
 
+    echo "== prefix-cache trie unit tests (radix tree vs the brute-force" \
+         "LCP oracle + LRU/byte-budget eviction + CoW pin semantics) =="
+    python -m pytest tests/test_llm_prefix.py -q -k "trie or privatize" \
+        -p no:cacheprovider
+
     echo "== llm microbench (smoke: tokens/s through the serving stack," \
          "swept over llm_steps_per_pool — superpool amortization) =="
     python -c 'import json, microbench; \

@@ -18,10 +18,13 @@ cd "$(dirname "$0")/.."
 
 # llm_decode_k is the k-step decode superpool's region program (ISSUE 9):
 # warming it is what keeps a region-lowered serving path
-# (--mca llm_lower_regions 1) from paying XLA at first-token time
+# (--mca llm_lower_regions 1) from paying XLA at first-token time.
+# llm_prefill_tail is the prefix-cache admission shape (ISSUE 11): a
+# trie-hit stream prefills only its unmatched tail, and warming that
+# pool geometry keeps cache hits from paying cold compile at admission.
 WORKLOADS=("$@")
 if [[ ${#WORKLOADS[@]} -eq 0 ]]; then
-    WORKLOADS=(gemm cholesky lu stencil llm_decode_k)
+    WORKLOADS=(gemm cholesky lu stencil llm_decode_k llm_prefill_tail)
 fi
 
 ARGS=()
