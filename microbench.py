@@ -485,6 +485,12 @@ def bench_llm(streams_sweep: tuple = (1, 4, 8),
         streams_sweep, steps_sweep, new_tokens = (1, 4), (1, 8), 8
     model = ToyLM()
     out: dict = {"llm_streams_sweep": {}, "llm_steps_sweep": {}}
+    # 64-token generations: the first ~10-16 tokens are the transition
+    # where the generation settles into its fixed point and the bigram
+    # table learns it — the spec axis must measure the draftable steady
+    # state, not the warmup (a 32-token stream is ~1/3 warmup and
+    # understates the speedup ~2x)
+    spec_streams, spec_tokens = 8, max(64, 4 * new_tokens)
     k_top = max(steps_sweep)
     saved_k = _params.get("llm_steps_per_pool")
     server = RuntimeServer(nb_cores=nb_cores)
@@ -557,6 +563,68 @@ def bench_llm(streams_sweep: tuple = (1, 4, 8),
     finally:
         _params.set("llm_steps_per_pool", saved_k)
         server.drain(timeout=60)
+
+    # the speculative-decode axis (ISSUE 12): off/2/4/adaptive on a
+    # DRAFTABLE (repetitive) workload at 8 streams — the ROADMAP's
+    # 10k+-tok/s leg.  Greedy ToyLM generations collapse to fixed
+    # points / short cycles on arithmetic-ramp prompts, which is
+    # exactly the templated-continuation shape the n-gram drafter
+    # predicts; "off" shares the workload so llm_spec_speedup compares
+    # the spec superpool against the PR-9 k-step path, nothing else.
+    # Fresh server per point: per-tenant acceptance priors and drafter
+    # state must not leak across points.
+    saved_spec = {k: _params.get(k) for k in ("llm_spec_k",
+                                              "llm_spec_adaptive")}
+    # 8 distinct arithmetic-ramp (offset, stride) prompts whose greedy
+    # generations collapse fast (~0.9 chain acceptance at draft 16 on
+    # the bigram simulation) — the draftable workload the ISSUE-12
+    # speedup criterion names; the "off" point runs the SAME prompts
+    spec_shapes = ((48, 5), (44, 9), (36, 11), (20, 11),
+                   (0, 3), (60, 1), (32, 3), (32, 1))
+    spec_prompts = [[(a + b * j) % model.vocab
+                     for j in range(prompt_len)]
+                    for a, b in spec_shapes[:spec_streams]]
+
+    def run_spec_point(spec_k: int, adaptive: bool) -> dict:
+        _params.set("llm_spec_k", spec_k)
+        _params.set("llm_spec_adaptive", adaptive)
+        with RuntimeServer(nb_cores=nb_cores) as server:
+            t0 = time.perf_counter()
+            tks = [server.submit_stream(p, max_new_tokens=spec_tokens,
+                                        tenant=f"tenant{i}")
+                   for i, p in enumerate(spec_prompts)]
+            for tk in tks:
+                tk.result(timeout=300)
+            wall = time.perf_counter() - t0
+            llm = server.stats()["llm"]
+        return {
+            "tokens_per_s": round(spec_streams * spec_tokens / wall, 1),
+            "accept_rate": llm.get("spec_accept_rate", 0.0),
+            "tokens_per_submit": llm.get("spec_tokens_per_submit", 0.0),
+            "rollbacks": llm["kv"]["tail_rollbacks"],
+        }
+
+    try:
+        out["llm_spec_sweep"] = {}
+        for label, k, ad in (("off", 0, False), ("2", 2, False),
+                             ("4", 4, False), ("adaptive", 16, True)):
+            point = run_spec_point(k, ad)
+            out["llm_spec_sweep"][label] = point
+            if note is not None:
+                note(phase="llm", **{f"llm_spec_{label}": point})
+        base = out["llm_spec_sweep"]["off"]["tokens_per_s"]
+        out["llm_spec_speedup"] = round(
+            out["llm_spec_sweep"]["adaptive"]["tokens_per_s"]
+            / max(base, 1e-9), 2)
+        out["llm_spec_accept_rate"] = \
+            out["llm_spec_sweep"]["adaptive"]["accept_rate"]
+        out["llm_spec_streams"] = spec_streams
+        out["llm_spec_new_tokens"] = spec_tokens
+        if note is not None:
+            note(phase="llm", llm_spec_speedup=out["llm_spec_speedup"])
+    finally:
+        for k, v in saved_spec.items():
+            _params.set(k, v)
     return out
 
 

@@ -97,6 +97,43 @@ def _model_graphs(nt: int):
         kv2, Q2, O2, TOK, EMB, list(prompts),
         [steps[s] for s in prompts])
 
+    # the speculative superpools (ISSUE 12), both incarnations.
+    # llm_decode_spec: one task per (position, page) with IN-GRAPH
+    # speculative appends — the rollback-facing WAR/WAW ordering of the
+    # speculative tail (position t's tail-page read AFTER position
+    # t-1's append, re-reads of written pages) must prove statically
+    # off the builder's last-writer/reader tables, like the PR-9 k-step
+    # schedule it generalizes.  llm_decode_spec_batched: the serving
+    # hot path's collapsed graph (one multi-query SATTN per page + one
+    # SVERIFY per stream over host-staged speculative slots).
+    from ..llm import (seed_spec_batched_pool, seed_spec_superpool,
+                       spec_batched_ptg, spec_superpool_ptg)
+    kv3 = PagedKVCollection("KVs", page_size=4, num_heads=H, head_dim=D)
+    DRAFT = DictCollection("DRAFTs", dtt=TileType((3, H, D), np.float32))
+    O3 = DictCollection("Os", dtt=TileType((H, D), np.float32))
+    STOK = DictCollection("STOKs", dtt=TileType((4,), np.float32))
+    DTOK = DictCollection("DTOKs", dtt=TileType((1,), np.float32))
+    EMB3 = DictCollection("EMBs", dtt=TileType(model.q3_table().shape,
+                                               np.float32))
+    drafts = {"a": [1] * max(2, nt // 2), "b": [2, 3]}  # mixed lengths
+    npos = seed_spec_superpool(model, kv3, DRAFT, DTOK, STOK, EMB3,
+                               prompts, drafts)
+    yield "llm_decode_spec", spec_superpool_ptg(
+        kv3, DRAFT, O3, STOK, DTOK, EMB3, list(prompts),
+        [npos[s] for s in prompts])
+
+    kv4 = PagedKVCollection("KVb", page_size=4, num_heads=H, head_dim=D)
+    pad = max(len(d) for d in drafts.values()) + 1
+    QS = DictCollection("QSb", dtt=TileType((pad, 3, H, D), np.float32))
+    LIM = DictCollection("LIMb", dtt=TileType((pad,), np.float32))
+    DTOKS = DictCollection("DTOKSb", dtt=TileType((pad + 2,), np.float32))
+    VOUT = DictCollection("VOUTb", dtt=TileType((pad + 2,), np.float32))
+    npos_b, pad = seed_spec_batched_pool(model, kv4, QS, LIM, DTOKS,
+                                         EMB3, prompts, drafts, pad=pad)
+    yield "llm_decode_spec_batched", spec_batched_ptg(
+        kv4, QS, LIM, DTOKS, VOUT, EMB3, list(prompts),
+        [npos_b[s] for s in prompts], pad=pad)
+
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
@@ -107,7 +144,8 @@ def main(argv: list[str] | None = None) -> int:
                     help="verify one graph: a model name (cholesky, lu, "
                          "pingpong, reduction, stencil1d, stencil2d, "
                          "tiled_gemm, all2all, llm_prefill, llm_decode, "
-                         "llm_decode_k) or a .jdf path")
+                         "llm_decode_k, llm_decode_spec, "
+                         "llm_decode_spec_batched) or a .jdf path")
     ap.add_argument("--bind", action="append", default=[],
                     metavar="NAME=INT", help="JDF global binding")
     ap.add_argument("--nt", type=int, default=5,

@@ -81,6 +81,9 @@ class PagedKVCollection(DataCollection):
         # host_tier_bytes / prefetch_inflight without a second surface)
         self.prefix_hits = 0
         self.prefix_pages_reused = 0
+        # speculative-decode rollback tallies (rollback_tail, ISSUE 12)
+        self.tail_rollbacks = 0
+        self.slots_rolled_back = 0
         self.tier: Any = None
 
     # -- the DataCollection vtable --------------------------------------
@@ -112,26 +115,35 @@ class PagedKVCollection(DataCollection):
                 and 0 <= page < len(table)
 
     # -- page lifecycle --------------------------------------------------
+    @staticmethod
+    def _scrub_copies(d: Data) -> int:
+        """The recycle-detach discipline, stated ONCE (recycle, CoW
+        privatize, speculative rollback and seed-time staging all apply
+        it): invalidate + detach every accelerator copy of one page —
+        a dirty device copy running AHEAD of host (deferred writeback,
+        device/tpu.py) must never satisfy a later stage-in version
+        check or write back over fresher host bytes — and return the
+        highest version ANY copy ever reached, which the caller's new
+        host version must jump PAST."""
+        with d._lock:
+            maxv = max(c.version for c in d.device_copies.values())
+            stale = [i for i in d.device_copies if i != 0]
+        for idx in stale:
+            c = d.get_copy(idx)
+            if c is not None:
+                c.coherency = COHERENCY_INVALID
+            d.detach_copy(idx)
+        return maxv
+
     def _new_page_locked(self) -> int:  # lint: holds(_lock)
         if self._free:
             phys = self._free.pop()
             self.pages_recycled += 1
-            # recycle the Data in place: fresh zeros, every accelerator
-            # copy detached+invalidated, and the host version jumped PAST
-            # the highest version any copy ever reached — a dirty device
-            # copy of the retired tenant (on-device writes run ahead of
-            # host until writeback, device/tpu.py) must never satisfy a
-            # stage-in version check for the new one
+            # recycle the Data in place: fresh zeros, stale copies
+            # scrubbed, host version jumped past every copy
             d = self._pages[phys]
             host = d.get_copy(0)
-            with d._lock:
-                maxv = max(c.version for c in d.device_copies.values())
-                stale = [i for i in d.device_copies if i != 0]
-            for idx in stale:
-                c = d.get_copy(idx)
-                if c is not None:
-                    c.coherency = COHERENCY_INVALID
-                d.detach_copy(idx)
+            maxv = self._scrub_copies(d)
             host.value = np.zeros(self.default_dtt.shape, self.dtype)
             host.version = maxv + 1
             # a device start_write may have left the host INVALID; the
@@ -270,6 +282,100 @@ class PagedKVCollection(DataCollection):
             self._tables[child] = list(shared)
             self._lens[child] = pages * self.page_size
 
+    def update_page_host(self, seq: Any, page: int, fn: Callable) -> None:
+        """Host-side page rewrite under the recycle-detach discipline —
+        the speculative seed-time staging path (ISSUE 12): ``fn`` gets
+        a private copy of the NEWEST live bytes (the tier or a device
+        copy may be ahead of host) and returns the page's new contents;
+        every accelerator copy is then invalidated + detached and the
+        host version jumps PAST the highest version any copy reached,
+        so a deferred device writeback can never clobber the staged
+        bytes or regress the host version.  Fails loudly (like
+        :meth:`rollback_tail` / ``_privatize_locked``) when no live
+        copy exists to stage from."""
+        with self._lock:
+            phys = self._tables[seq][page]
+            d = self._pages[phys]
+        src = d.newest_copy()
+        if src is None or src.value is None:
+            raise RuntimeError(
+                f"{self.name}: page {phys} has no live copy to stage "
+                f"a host write from (spilled beyond the host tier?)")
+        val = fn(np.array(np.asarray(src.value), copy=True))
+        host = d.get_copy(0)
+        maxv = self._scrub_copies(d)
+        host.value = np.asarray(val)
+        host.version = maxv + 1
+        host.coherency = COHERENCY_SHARED
+        d.owner_device = 0
+
+    def rollback_tail(self, seq: Any, new_len: int) -> int:
+        """Truncate ``seq``'s speculatively-written tail back to
+        ``new_len`` tokens — the speculative-decode rollback primitive
+        (ISSUE 12): a rejected draft's K/V appends must never leak into
+        the next superpool as stale cache.
+
+        Every slot in ``[new_len, seq_len)`` is scrubbed: K/V zeroed,
+        the in-tensor fill count reset to the kept slots, and — the
+        recycle-detach discipline of :meth:`_new_page_locked` /
+        :meth:`_privatize_locked` — each touched page's accelerator
+        copies are invalidated+detached and its host version jumps PAST
+        the highest version any copy ever reached, so a dirty device
+        copy holding the rejected appends can never satisfy a later
+        stage-in version check.  The boundary page's KEPT slots are
+        sourced from the newest live copy (on-device writes run ahead
+        of host until writeback).  The length ledger lands at
+        ``new_len``; trailing preallocated-but-never-written pages stay
+        in the table (they are zeroed and the next superpool's schedule
+        reuses them).  Returns the number of slots rolled back (0 =
+        nothing to do)."""
+        with self._lock:
+            table = self._tables[seq]
+            old_len = self._lens[seq]
+            if not 0 <= new_len <= old_len:
+                raise ValueError(
+                    f"rollback of {seq!r} to {new_len} outside its "
+                    f"[0, {old_len}] ledger")
+            if new_len == old_len:
+                return 0
+            P = self.page_size
+            for page in range(new_len // P,
+                              min((old_len - 1) // P + 1, len(table))):
+                phys = table[page]
+                if self._refs[phys] > 1:
+                    # speculative slots are only ever written through a
+                    # privatized tail — a shared page in the rollback
+                    # range means the ledger and the block table
+                    # disagree; scrubbing it would corrupt the sibling
+                    raise RuntimeError(
+                        f"{self.name}: rollback range page {phys} of "
+                        f"{seq!r} is shared ({self._refs[phys]} refs)")
+                keep = max(0, min(new_len - page * P, P))
+                d = self._pages[phys]
+                host = d.get_copy(0)
+                if keep == 0:
+                    val = np.zeros(self.default_dtt.shape, self.dtype)
+                else:
+                    src = d.newest_copy()
+                    if src is None or src.value is None:
+                        raise RuntimeError(
+                            f"{self.name}: page {phys} has no live copy "
+                            f"to roll back from (spilled beyond the "
+                            f"host tier?)")
+                    val = np.array(np.asarray(src.value), copy=True)
+                    val[K_CH, keep:] = 0.0
+                    val[V_CH, keep:] = 0.0
+                    val[META_CH, 0, 0, 0] = keep
+                maxv = self._scrub_copies(d)
+                host.value = val
+                host.version = maxv + 1
+                host.coherency = COHERENCY_SHARED
+                d.owner_device = 0
+            self._lens[seq] = new_len
+            self.tail_rollbacks += 1
+            self.slots_rolled_back += old_len - new_len
+            return old_len - new_len
+
     def has_seq(self, seq: Any) -> bool:
         with self._lock:
             return seq in self._tables
@@ -338,6 +444,8 @@ class PagedKVCollection(DataCollection):
                 # and spill pressure off the SAME dict
                 "prefix_hits": self.prefix_hits,
                 "prefix_pages_reused": self.prefix_pages_reused,
+                "tail_rollbacks": self.tail_rollbacks,
+                "slots_rolled_back": self.slots_rolled_back,
                 "host_tier_bytes": (self.tier.host_tier_bytes
                                     if self.tier is not None else 0),
                 "prefetch_inflight": (self.tier.prefetch_inflight

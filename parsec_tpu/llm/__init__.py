@@ -1,17 +1,27 @@
 """LLM inference serving on the runtime's own primitives: a paged KV
 cache as a :class:`~parsec_tpu.data_dist.paged_kv.PagedKVCollection`,
-ragged prefill/decode task classes (:mod:`parsec_tpu.llm.decode`), and
-continuous batching over a :class:`~parsec_tpu.serve.RuntimeServer`
+ragged prefill/decode task classes (:mod:`parsec_tpu.llm.decode`),
+speculative draft-k-verify superpools (ISSUE 12), and continuous
+batching over a :class:`~parsec_tpu.serve.RuntimeServer`
 (:mod:`parsec_tpu.llm.batcher`).  See ``docs/LLM.md``."""
 
 from ..data_dist.paged_kv import PagedKVCollection
 from .batcher import ContinuousBatcher, StreamTicket
 from .decode import (decode_step_ptg, decode_superpool_ptg,
                      preallocate_decode_steps, prefill_chunks, prefill_ptg,
-                     read_token_chain, seed_decode_superpool)
-from .model import ToyLM
+                     read_spec_batched, read_spec_chain, read_token_chain,
+                     seed_decode_superpool, seed_spec_batched,
+                     seed_spec_batched_pool, seed_spec_stream,
+                     seed_spec_superpool, spec_batched_ptg,
+                     spec_superpool_ptg)
+from .model import NgramDrafter, ToyLM
 
-__all__ = ["PagedKVCollection", "ToyLM", "ContinuousBatcher",
-           "StreamTicket", "decode_step_ptg", "decode_superpool_ptg",
-           "preallocate_decode_steps", "prefill_ptg", "prefill_chunks",
-           "read_token_chain", "seed_decode_superpool"]
+__all__ = ["PagedKVCollection", "ToyLM", "NgramDrafter",
+           "ContinuousBatcher", "StreamTicket", "decode_step_ptg",
+           "decode_superpool_ptg", "preallocate_decode_steps",
+           "prefill_ptg", "prefill_chunks", "read_token_chain",
+           "read_spec_chain", "read_spec_batched",
+           "seed_decode_superpool", "seed_spec_stream",
+           "seed_spec_batched", "seed_spec_batched_pool",
+           "seed_spec_superpool", "spec_batched_ptg",
+           "spec_superpool_ptg"]

@@ -17,7 +17,14 @@ The superpool (ISSUE 9) is the amortization move: sampling runs
 IN-GRAPH (the SAMPLE task class, ``llm/decode.decode_superpool_ptg``),
 so one pool spans ``llm_steps_per_pool`` autoregressive iterations and
 the per-pool submit/termdet overhead (~1-2 ms) is paid once per k
-tokens, not once per token.  EOS and early-finishing streams ride
+tokens, not once per token.  With ``llm_spec_k`` set, streams whose
+n-gram drafter has a proposal ride a **speculative superpool** instead
+(ISSUE 12, ``llm/decode.spec_superpool_ptg``): the draft's 1+k
+positions verify in one batched ragged-attention pass with NO serial
+sample chain, the in-graph VERIFY class computes the accepted prefix,
+and the rejected tail's speculative KV appends roll back
+(``PagedKVCollection.rollback_tail``) before the next pool — per-stream
+draft length adapts live from the observed acceptance rate.  EOS and early-finishing streams ride
 predicated step bodies — a finished stream's remaining tasks no-op, so
 it wastes at most its own tail tasks.  Prefill pools for arriving
 streams are submitted BEFORE the decode superpools are awaited, so a
@@ -57,9 +64,10 @@ from ..data_dist.collection import DictCollection
 from ..data_dist.kv_tiers import KVTierMap
 from ..data_dist.paged_kv import PagedKVCollection
 from .decode import (decode_superpool_ptg, preallocate_decode_steps,
-                     prefill_chunks, prefill_ptg, read_token_chain,
-                     seed_emb_table, seed_stream_step)
-from .model import ToyLM
+                     prefill_chunks, prefill_ptg, read_spec_batched,
+                     read_token_chain, seed_emb_table, seed_spec_batched,
+                     seed_stream_step, spec_batched_ptg)
+from .model import NgramDrafter, ToyLM
 from .prefix_tree import PrefixTree
 
 _params.register("llm_page_size", 16,
@@ -92,6 +100,25 @@ _params.register("llm_lower_regions", False,
                  "verified region (compile cost rides the lowering "
                  "cache / AOT warming; pools that cannot lower fall "
                  "back to the dynamic path)")
+_params.register("llm_spec_k", 0,
+                 "speculative decode (ISSUE 12): draft tokens the "
+                 "per-stream n-gram drafter proposes per superpool "
+                 "(0 = off).  A spec superpool verifies 1+k positions "
+                 "in ONE batched ragged-attention pass — every "
+                 "position's query is known at build time, so the "
+                 "PR-9 serial SAMPLE chain disappears; the in-graph "
+                 "VERIFY chain predicates rejected tails off and the "
+                 "batcher rolls their speculative KV appends back "
+                 "(PagedKVCollection.rollback_tail)")
+_params.register("llm_spec_adaptive", True,
+                 "adapt each stream's draft length within "
+                 "[0, llm_spec_k] from its observed acceptance-rate "
+                 "EWMA: draftable traffic grows toward the cap, "
+                 "undraftable traffic converges to 0 and falls back "
+                 "to the non-speculative k-step superpool (with a "
+                 "periodic cheap probe), so acceptance-rate-0 traffic "
+                 "degrades to the PR-9 path instead of paying "
+                 "rejected drafts forever")
 _params.register("llm_prefetch_ahead", True,
                  "stage live streams' device-evicted KV pages back in "
                  "one superpool ahead of the decode wavefront (the "
@@ -111,9 +138,16 @@ _retired_lock = threading.Lock()
 
 _REPORT_KEYS = ("tokens_generated", "streams_completed", "decode_submits",
                 "forked_streams", "prefill_tokens_total",
-                "prefill_tokens_skipped")
+                "prefill_tokens_skipped", "spec_submits", "spec_tokens",
+                "spec_drafted", "spec_drafts_accepted")
 _REPORT_KV_KEYS = ("prefix_hits", "prefix_pages_reused", "host_tier_bytes",
-                   "prefetch_inflight", "physical_pages", "cow_copies")
+                   "prefetch_inflight", "physical_pages", "cow_copies",
+                   "tail_rollbacks", "slots_rolled_back")
+
+# iterations a converged-off adaptive stream waits before probing spec
+# again (2 small probe pools per interval; at k=8 plain pools the probe
+# tax is ~3% of throughput — inside the acceptance-rate-0 10% budget)
+_SPEC_PROBE_EVERY = 64
 
 
 def _fold_stats(out: dict, s: dict) -> None:
@@ -138,6 +172,16 @@ def aggregate_report() -> dict:
         out["prefill_skipped_frac"] = round(
             out.get("prefill_tokens_skipped", 0) / total, 4) if total \
             else 0.0
+        # the speculative-decode effectiveness pair (ISSUE 12): how
+        # often drafts were right, and how many tokens one spec
+        # superpool ride yields per stream — cumulative like the rest
+        if out.get("spec_drafted"):
+            out["spec_accept_rate"] = round(
+                out.get("spec_drafts_accepted", 0)
+                / out["spec_drafted"], 4)
+        if out.get("spec_submits"):
+            out["spec_tokens_per_submit"] = round(
+                out.get("spec_tokens", 0) / out["spec_submits"], 4)
     return out
 
 
@@ -156,6 +200,11 @@ class StreamTicket:
         self.prefill_s: float | None = None
         self.first_token_at: float | None = None   # monotonic TTFT stamp
         self.prefix_pages_reused = 0   # trie pages this stream skipped
+        # speculative-decode visibility (ISSUE 12): the stream's current
+        # (possibly adapted) draft cap and its acceptance-rate EWMA,
+        # updated after every spec superpool it rides
+        self.spec_k: int | None = None
+        self.spec_accept_ewma: float | None = None
         # the stream's trace context (prof/spans.py): the request-scoped
         # identity of this generation, named by stall dumps and carried
         # by every decode superpool ticket the stream rides
@@ -191,7 +240,8 @@ class StreamTicket:
 
 class _Stream:
     __slots__ = ("seq", "tenant", "priority", "prompt", "max_new",
-                 "ticket", "cur", "devices", "eos", "fork_from", "k")
+                 "ticket", "cur", "devices", "eos", "fork_from", "k",
+                 "spec", "drafter", "spec_k", "spec_ewma", "spec_probe")
 
     def __init__(self, seq: Any, tenant: str, priority: int,
                  prompt: Sequence[int], max_new: int,
@@ -207,6 +257,15 @@ class _Stream:
         self.eos = None if eos is None else int(eos)
         self.fork_from = fork_from      # CoW prompt-KV parent (or None)
         self.k = 1                      # steps the current superpool runs
+        self.spec = False               # current pool is speculative
+        # the stream's drafter, built LAZILY in the batcher thread the
+        # first time speculation considers this stream (llm_spec_k off
+        # = never): submit_stream stays O(1) — client-side prompt
+        # walking here widens the fork-classification arrival window
+        self.drafter: NgramDrafter | None = None
+        self.spec_k = -1                # adaptive draft cap (-1 = unset)
+        self.spec_ewma = -1.0           # acceptance EWMA (-1 = unset)
+        self.spec_probe = 0             # iterations since converged off
 
 
 class ContinuousBatcher:
@@ -235,6 +294,23 @@ class ContinuousBatcher:
         # table the SAMPLE kernel computes logits/next-queries from
         # (one gather per token — ToyLM.q3_table)
         self.TOK = DictCollection("llmTOK", dtt=TileType((3,), np.float32))
+        # the batched speculative superpool's side collections (ISSUE
+        # 12, llm/decode.spec_batched_ptg): QS the per-position query
+        # stacks (position 0 the real current token, 1.. the drafter's
+        # proposals), LIM the per-(seq, page) causal slot limits, DTOKS
+        # the packed draft chain the SVERIFY body compares, VOUT the
+        # accepted-prefix result the host reads once per spec pool.
+        # Tile shapes are per-pool (padded to llm_spec_k + 1); the
+        # declared dtts only serve lazy zero-init before the first seed
+        sp0 = max(1, int(_params.get("llm_spec_k"))) + 1
+        self.QS = DictCollection("llmQS",
+                                 dtt=TileType((sp0, 3, H, D), np.float32))
+        self.LIM = DictCollection("llmLIM",
+                                  dtt=TileType((sp0,), np.float32))
+        self.DTOKS = DictCollection("llmDTOKS",
+                                    dtt=TileType((sp0 + 2,), np.float32))
+        self.VOUT = DictCollection("llmVOUT",
+                                   dtt=TileType((sp0 + 2,), np.float32))
         self.EMB = DictCollection(
             "llmEMB", dtt=TileType(self.model.q3_table().shape, np.float32))
         seed_emb_table(self.model, self.EMB)
@@ -266,6 +342,19 @@ class ContinuousBatcher:
         self.streams_completed = 0
         self.decode_submits = 0         # superpool submits (1/k per token)
         self.forked_streams = 0         # streams whose prompt KV forked
+        # speculative-decode tallies (ISSUE 12): spec_submits counts
+        # per-stream spec-superpool rides (the unit spec_tokens_per_
+        # submit amortizes over), spec_drafted/accepted the drafter's
+        # proposal hit rate
+        self.spec_submits = 0
+        self.spec_tokens = 0
+        self.spec_drafted = 0
+        self.spec_drafts_accepted = 0
+        # per-tenant acceptance prior (batcher thread only): new streams
+        # start their adaptive draft cap where the tenant's traffic
+        # converged, so undraftable workloads don't pay the cap->0
+        # descent once per stream — only the staggered probes remain
+        self._spec_prior: dict[str, float] = {}
         self._pool_seq = itertools.count()
         _live_batchers.add(self)
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -337,7 +426,17 @@ class ContinuousBatcher:
                 "forked_streams": self.forked_streams,
                 "prefill_tokens_total": self.prefill_tokens_total,
                 "prefill_tokens_skipped": self.prefill_tokens_skipped,
+                "spec_submits": self.spec_submits,
+                "spec_tokens": self.spec_tokens,
+                "spec_drafted": self.spec_drafted,
+                "spec_drafts_accepted": self.spec_drafts_accepted,
             }
+        if out["spec_drafted"]:
+            out["spec_accept_rate"] = round(
+                out["spec_drafts_accepted"] / out["spec_drafted"], 4)
+        if out["spec_submits"]:
+            out["spec_tokens_per_submit"] = round(
+                out["spec_tokens"] / out["spec_submits"], 4)
         out["kv"] = self.kv.stats()
         out["tiers"] = self.tiers.stats()
         if self.prefix is not None:
@@ -436,9 +535,11 @@ class ContinuousBatcher:
         self.kv.free_seq(seq)
         self.Q.discard(seq)
         self.O.discard(seq)
-        for key in self.TOK.known_keys():
-            if key and key[0] == seq:
-                self.TOK.discard(*key)
+        for coll in (self.TOK, self.QS, self.LIM, self.DTOKS,
+                     self.VOUT):
+            for key in coll.known_keys():
+                if key and key[0] == seq:
+                    coll.discard(*key)
 
     def _fail_all(self, e: BaseException) -> None:
         with self._lock:
@@ -497,19 +598,42 @@ class ContinuousBatcher:
         runs the decode superpools while these are in flight.  An
         exhausted page budget fails ONE stream, a shed pool fails ONE
         tenant's arrivals, never the whole batch.  Fork-on-prompt
-        children skip prefill entirely and resolve in
-        :meth:`_prefill_await` once their parent's pages are real."""
+        children skip prefill entirely: a child of an already-admitted
+        parent sitting at its prompt boundary forks HERE (before this
+        iteration's decode can advance the parent); a child arriving in
+        the same batch as its parent resolves in :meth:`_prefill_await`
+        once the parent's pages are real."""
         stream_chunks: dict[Any, dict[tuple, np.ndarray]] = {}
         chunk_starts: dict[Any, int] = {}
         by_tenant: dict[str, list[_Stream]] = {}
         forks: list[_Stream] = []
+        ok: list[_Stream] = []
         fresh_ids = {id(st) for st in fresh}
         for st in fresh:
             parent = st.fork_from
-            if parent is not None and (id(parent) in fresh_ids
-                                       or self._fork_ready(parent)):
+            if parent is not None and id(parent) in fresh_ids:
+                # parent arrives in THIS batch: its pages are not real
+                # until its PF pool completes — defer to _prefill_await
                 st.ticket.state = "prefill"
                 forks.append(st)
+                continue
+            if parent is not None and self._fork_ready(parent):
+                # already-admitted parent sitting exactly at its prompt
+                # boundary: fork NOW, before this iteration's decode
+                # superpool advances it (the window that used to force
+                # the fallback).  CoW keeps the snapshot honest — the
+                # parent's next append privatizes ITS tail, the child
+                # keeps the prompt pages.
+                try:
+                    self.kv.fork(parent.seq, st.seq)
+                except BaseException as e:   # noqa: BLE001 — contain
+                    self._retire_failed([st], e)
+                    continue
+                st.fork_from = None
+                st.ticket.state = "prefill"
+                with self._lock:
+                    self.forked_streams += 1
+                ok.append(st)
                 continue
             st.fork_from = None          # parent advanced: plain prefill
             try:
@@ -528,7 +652,6 @@ class ContinuousBatcher:
             by_tenant.setdefault(st.tenant, []).append(st)
         t0 = time.perf_counter()
         tickets: list[tuple[Any, Any, list[_Stream]]] = []
-        ok: list[_Stream] = []
         done_t: dict[int, float] = {}
         for tenant, group in by_tenant.items():
             # only streams with tail chunks ride a PF pool: single-token
@@ -578,7 +701,9 @@ class ContinuousBatcher:
         that join the live batch."""
         ok: list[_Stream] = list(state["ok"])
         for st in ok:
-            st.ticket.prefill_s = 0.0     # single-token: nothing cached
+            # single-token prompts cache nothing; early (phase-1) forks
+            # shared CoW — either way no bytes moved
+            st.ticket.prefill_s = 0.0
         for tk, tp, group in state["tickets"]:
             try:
                 tk.result(timeout=_params.get("llm_step_timeout"))
@@ -598,20 +723,16 @@ class ContinuousBatcher:
         fallback: list[_Stream] = []
         for st in state["forks"]:
             parent = st.fork_from
-            # an in-batch parent must have actually COMPLETED its PF
-            # pool: the host-side length ledger advances at chunk time,
+            # deferred forks all have IN-BATCH parents (out-of-batch
+            # parents forked at phase-1 classification), and an
+            # in-batch parent must have actually COMPLETED its PF pool:
+            # the host-side length ledger advances at chunk time,
             # BEFORE the pool runs, so _fork_ready alone cannot prove
             # the parent's pages hold real bytes (a timed-out PF pool
-            # may still be writing them).  An out-of-batch parent must
-            # still sit exactly at its prompt boundary — it may have
-            # run a decode superpool since phase 1 classified us.
-            # Either miss takes the documented silent fallback: the
-            # child re-prefills its own prompt like any fresh stream.
-            if id(parent) in state["fresh_ids"]:
-                ready = id(parent) in ok_ids
-            else:
-                ready = self._fork_ready(parent)
-            if not ready:
+            # may still be writing them).  A miss takes the documented
+            # silent fallback: the child re-prefills its own prompt
+            # like any fresh stream.
+            if not (id(parent) in ok_ids):
                 st.fork_from = None
                 fallback.append(st)
                 continue
@@ -659,16 +780,172 @@ class ContinuousBatcher:
         except LoweringError:
             return tp
 
+    def _spec_draft(self, st: _Stream, spec_cap: int,
+                    adaptive: bool) -> list[int] | None:
+        """Decide whether THIS stream's next superpool is speculative,
+        and with what draft.  None = ride the non-speculative PR-9
+        superpool (spec off, no remaining budget to draft into, the
+        drafter has no proposal, or the adaptive controller converged
+        the stream off).  A converged-off stream re-probes every
+        ``_SPEC_PROBE_EVERY`` iterations with a 2-token draft and a
+        neutral EWMA, so traffic that TURNS draftable is re-detected at
+        a bounded (~3%) probe tax."""
+        remaining = st.max_new - len(st.ticket.tokens)
+        if spec_cap <= 0 or remaining <= 1:
+            return None
+        if st.drafter is None:
+            # first speculative look at this stream: the drafter sees
+            # every token the stream KEEPS, prompt first, then whatever
+            # was already generated under non-speculative iterations —
+            # the table tracks the true history whatever mode ran
+            st.drafter = NgramDrafter()
+            for t in st.prompt:
+                st.drafter.observe(int(t))
+            for t in st.ticket.tokens:
+                st.drafter.observe(int(t))
+        cap = min(spec_cap, remaining - 1)
+        if adaptive:
+            if st.spec_k < 0:
+                # optimistic start at the cap — unless the tenant's
+                # traffic already proved undraftable, then start OFF
+                # (staggered so a tenant's probes don't align)
+                prior = self._spec_prior.get(st.tenant)
+                if prior is not None and prior < 0.35:
+                    st.spec_k = 0
+                    st.spec_probe = (hash(st.seq)
+                                     % _SPEC_PROBE_EVERY)
+                else:
+                    st.spec_k = spec_cap
+            if st.spec_k == 0:
+                st.spec_probe += 1
+                if st.spec_probe < _SPEC_PROBE_EVERY:
+                    return None
+                st.spec_probe = 0
+                st.spec_k = 2
+                st.spec_ewma = 0.5
+            cap = min(cap, st.spec_k)
+        if cap < 1:
+            return None
+        return st.drafter.draft(st.cur, cap) or None
+
+    def _note_spec(self, st: _Stream, toks: list[int],
+                   done: bool) -> None:
+        """Fold one spec-superpool ride into the stream's adaptive
+        controller and the serving counters/SLO plane.  An EOS finish
+        scores 1.0 — the chain was cut by the stream, not by a draft
+        miss — so a stream that dies mid-draft never punishes the
+        drafter."""
+        drafted = st.k - 1
+        accepted = len(toks) - 1
+        rate = 1.0 if done else accepted / max(1, drafted)
+        st.spec_ewma = rate if st.spec_ewma < 0.0 else \
+            0.5 * st.spec_ewma + 0.5 * rate
+        prior = self._spec_prior.get(st.tenant)
+        self._spec_prior[st.tenant] = rate if prior is None else \
+            0.5 * prior + 0.5 * rate
+        adaptive = bool(_params.get("llm_spec_adaptive"))
+        spec_cap = max(0, int(_params.get("llm_spec_k")))
+        if adaptive:
+            # the live-adaptation shape the autotuning ROADMAP item
+            # wants: double toward the cap while drafts land, halve to
+            # (eventually) 0 = the non-speculative fallback while they
+            # miss — convergence to either extreme takes ~3 pools
+            if st.spec_ewma >= 0.6:
+                st.spec_k = min(spec_cap, max(2, st.spec_k * 2))
+            elif st.spec_ewma < 0.35:
+                st.spec_k //= 2
+        st.ticket.spec_k = st.spec_k if adaptive else spec_cap
+        st.ticket.spec_accept_ewma = round(st.spec_ewma, 4)
+        with self._lock:
+            self.spec_submits += 1
+            self.spec_tokens += len(toks)
+            self.spec_drafted += drafted
+            self.spec_drafts_accepted += accepted
+        if self._slo is not None:
+            # the PR-10 SLO plane's per-tenant speculative pair: how
+            # often drafts land, and the tokens one submit yields —
+            # read live via RuntimeServer.metrics() next to the
+            # inter-token quantiles speculation is supposed to move
+            self._slo.observe(st.tenant, "spec_accept_rate", rate)
+            self._slo.observe(st.tenant, "spec_tokens_per_submit",
+                              len(toks))
+
+    def _collect_stream(self, st: _Stream, dt: float) -> bool:
+        """Read ONE stream's tokens off its completed superpool and fold
+        them into the ticket/ledger/SLO state; returns whether the
+        stream finished (EOS or budget).  Speculative streams read the
+        accepted prefix and roll their rejected tail back; plain
+        streams read the TOK chain."""
+        if st.spec:
+            # only the accepted prefix surfaces — the SVERIFY body
+            # killed the chain at the first draft mismatch (or a live
+            # EOS) in-graph
+            toks, done = read_spec_batched(self.VOUT, st.seq)
+            # every position's k/v was staged into the tail slots at
+            # seed time; the ledger advances by the FULL position
+            # count, then the rejected tail rolls back (version-jump
+            # truncation) so no stale KV survives into the next
+            # superpool.  QS/LIM/DTOKS tiles are rewritten by the next
+            # seed — they release with the stream, not per iteration
+            self.kv.note_appended(st.seq, st.k)
+            rejected = st.k - len(toks)
+            if rejected:
+                self.kv.rollback_tail(
+                    st.seq, self.kv.seq_len(st.seq) - rejected)
+            self._note_spec(st, toks, done)
+        else:
+            # tokens past a mid-superpool EOS are the predicated tail —
+            # read_token_chain never surfaces them
+            toks, done = read_token_chain(self.TOK, st.seq, st.k)
+            for t_i in range(st.k):
+                self.TOK.discard(st.seq, t_i)
+            # the ledger advances by the FULL k: the OUT bodies
+            # appended every step's k/v (predication holds tokens, not
+            # appends), and a done stream's pages free anyway
+            self.kv.note_appended(st.seq, st.k)
+        if st.drafter is not None:
+            # keep the table aligned with the true history whatever
+            # mode this iteration ran, so spec can re-engage any time
+            # (never-speculated streams catch up lazily in _spec_draft)
+            for t_i in toks:
+                st.drafter.observe(t_i)
+        st.cur = toks[-1]
+        if toks and not st.ticket.tokens:
+            # the stream's first token closes its TTFT (the stamp is
+            # what the bench prefix sweep quantiles)
+            st.ticket.first_token_at = time.monotonic()
+            if self._slo is not None:
+                self._slo.observe(
+                    st.tenant, "ttft_ms",
+                    (st.ticket.first_token_at
+                     - st.ticket.submitted_at) * 1e3)
+        if self._slo is not None and toks:
+            # every token samples the inter-token latency (this
+            # iteration's wall amortized over its k tokens)
+            tok_ms = dt / len(toks) * 1e3
+            for _ in toks:
+                self._slo.observe(st.tenant, "tok_latency_ms", tok_ms)
+        with self._lock:
+            st.ticket.tokens.extend(toks)
+            st.ticket.per_token_s.extend([dt] * len(toks))
+            self.tokens_generated += len(toks)
+        return done or len(st.ticket.tokens) >= st.max_new
+
     def _decode_step(self, live: list[_Stream]) -> None:
-        """One continuous-batching iteration: ONE k-step decode
-        superpool per tenant over its live streams, with k =
+        """One continuous-batching iteration: ONE decode superpool per
+        (tenant, mode) over its live streams — speculative draft-k-
+        verify pools for streams whose drafter has a proposal (ISSUE
+        12), the PR-9 k-step SAMPLE superpool for the rest, with k =
         ``llm_steps_per_pool`` clipped to each stream's remaining
-        budget.  Sampling runs in-graph (the SAMPLE class), so the host
-        reads k tokens off the TOK chain tiles per submit instead of
-        re-entering the runtime per token.  Failures are contained per
-        stream (slot allocation) or per tenant (pool shed/failure) —
-        the rest of the batch decodes on."""
+        budget.  Sampling/verification runs in-graph, so the host reads
+        a whole pool's tokens off the TOK/STOK chain tiles per submit;
+        a spec stream's rejected tail is rolled back
+        (``rollback_tail``) before its next pool.  Failures are
+        contained per stream (slot allocation) or per tenant+mode (pool
+        shed/failure) — the rest of the batch decodes on."""
         k_max = max(1, int(_params.get("llm_steps_per_pool")))
+        spec_cap = max(0, int(_params.get("llm_spec_k")))
+        spec_adaptive = bool(_params.get("llm_spec_adaptive"))
         if _params.get("llm_prefetch_ahead"):
             # the tier return path, ahead of the decode wavefront: pages
             # the PREVIOUS iteration's eviction pressure pushed to the
@@ -687,28 +964,54 @@ class ContinuousBatcher:
                 self._slo.inc("_server", "kv_prefetched_pages", n)
         ready: list[_Stream] = []
         for st in live:
-            k = max(1, min(k_max, st.max_new - len(st.ticket.tokens)))
+            draft = self._spec_draft(st, spec_cap, spec_adaptive)
             try:
-                preallocate_decode_steps(self.kv, st.seq, k)
-                seed_stream_step(self.model, self.Q, self.TOK, st.seq,
-                                 st.cur, eos=st.eos)
+                if draft is not None:
+                    st.k = 1 + len(draft)
+                    st.spec = True
+                    # preallocate FIRST: the staged speculative slots
+                    # must be private (CoW tails privatize here) before
+                    # the seed writes the draft chain's k/v into them
+                    preallocate_decode_steps(self.kv, st.seq, st.k)
+                    seed_spec_batched(self.model, self.kv, self.QS,
+                                      self.LIM, self.DTOKS, st.seq,
+                                      st.cur, draft, spec_cap + 1,
+                                      eos=st.eos)
+                else:
+                    st.k = max(1, min(k_max,
+                                      st.max_new - len(st.ticket.tokens)))
+                    st.spec = False
+                    preallocate_decode_steps(self.kv, st.seq, st.k)
+                    seed_stream_step(self.model, self.Q, self.TOK,
+                                     st.seq, st.cur, eos=st.eos)
             except BaseException as e:       # noqa: BLE001 — contain
                 self._retire_failed([st], e)
                 continue
-            st.k = k
             ready.append(st)
-        by_tenant: dict[str, list[_Stream]] = {}
+        # one pool per (tenant, mode): spec and plain streams of a
+        # tenant ride SEPARATE superpools in the same iteration (the
+        # two graphs differ structurally; WFQ still arbitrates both
+        # under the tenant's weight)
+        by_group: dict[tuple[str, bool], list[_Stream]] = {}
         for st in ready:
-            by_tenant.setdefault(st.tenant, []).append(st)
+            by_group.setdefault((st.tenant, st.spec), []).append(st)
         t0 = time.perf_counter()
         submitted: list[tuple[Any, Any, list[_Stream]]] = []
-        for tenant, group in by_tenant.items():
+        for (tenant, spec), group in by_group.items():
             try:
-                tp = decode_superpool_ptg(
-                    self.kv, self.Q, self.O, self.TOK, self.EMB,
-                    [st.seq for st in group], [st.k for st in group],
-                    devices=self.devices,
-                    name=f"llm_decode{next(self._pool_seq)}")
+                if spec:
+                    tp = spec_batched_ptg(
+                        self.kv, self.QS, self.LIM, self.DTOKS,
+                        self.VOUT, self.EMB, [st.seq for st in group],
+                        [st.k for st in group], pad=spec_cap + 1,
+                        devices=self.devices,
+                        name=f"llm_spec{next(self._pool_seq)}")
+                else:
+                    tp = decode_superpool_ptg(
+                        self.kv, self.Q, self.O, self.TOK, self.EMB,
+                        [st.seq for st in group], [st.k for st in group],
+                        devices=self.devices,
+                        name=f"llm_decode{next(self._pool_seq)}")
                 tp = self._maybe_lower_regions(tp)
                 submitted.append((self._server.submit(
                     tp, tenant=tenant,
@@ -730,38 +1033,14 @@ class ContinuousBatcher:
                 continue
             dt = time.perf_counter() - t0
             for st in group:
-                # tokens past a mid-superpool EOS are the predicated
-                # tail — read_token_chain never surfaces them
-                toks, done = read_token_chain(self.TOK, st.seq, st.k)
-                for t_i in range(st.k):
-                    self.TOK.discard(st.seq, t_i)
-                # the ledger advances by the FULL k: the OUT bodies
-                # appended every step's k/v (predication holds tokens,
-                # not appends), and a done stream's pages free anyway
-                self.kv.note_appended(st.seq, st.k)
-                st.cur = toks[-1]
-                if toks and not st.ticket.tokens:
-                    # the stream's first token closes its TTFT (the
-                    # stamp is what the bench prefix sweep quantiles)
-                    st.ticket.first_token_at = time.monotonic()
-                    if self._slo is not None:
-                        self._slo.observe(
-                            st.tenant, "ttft_ms",
-                            (st.ticket.first_token_at
-                             - st.ticket.submitted_at) * 1e3)
-                if self._slo is not None and toks:
-                    # every token samples the inter-token latency (this
-                    # iteration's wall amortized over its k tokens)
-                    tok_ms = dt / len(toks) * 1e3
-                    for _ in toks:
-                        self._slo.observe(st.tenant, "tok_latency_ms",
-                                          tok_ms)
-                with self._lock:
-                    st.ticket.tokens.extend(toks)
-                    st.ticket.per_token_s.extend([dt] * len(toks))
-                    self.tokens_generated += len(toks)
-                if done or len(st.ticket.tokens) >= st.max_new:
-                    finished.append(st)
+                try:
+                    if self._collect_stream(st, dt):
+                        finished.append(st)
+                except BaseException as e:   # noqa: BLE001 — contain
+                    # one stream's result/rollback failure (e.g. a
+                    # rolled-back page spilled beyond the host tier)
+                    # must fail THAT stream, not the batcher
+                    self._retire_failed([st], e)
         with self._lock:
             self.steps += 1
             for st in finished:

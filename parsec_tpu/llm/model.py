@@ -99,3 +99,50 @@ class ToyLM:
             if eos is not None and cur == int(eos):
                 break
         return out
+
+
+class NgramDrafter:
+    """Last-wins bigram proposal table — the cheap drafter behind the
+    speculative superpool (ISSUE 12).
+
+    The batcher feeds every token a stream actually KEPT (prompt, then
+    each surfaced token) through :meth:`observe`, and :meth:`draft`
+    walks the table greedily from the current token — O(1) per observed
+    token, O(k) per draft, no model math, so drafting rides the host
+    prep slice of the iteration without touching the serving hot path.
+    Deterministic: the same history always drafts the same chain, which
+    is what keeps the acceptance-rate tests reproducible.
+
+    Repetitive traffic (greedy ToyLM generations collapse to fixed
+    points / short cycles; real serving's draftable shapes are
+    templated continuations) hits 80-95% bigram accuracy; adversarial
+    traffic drafts garbage — rejection costs only the rejected tail's
+    tasks, and the batcher's adaptive controller shrinks ``spec_k``
+    toward the non-speculative path.
+    """
+
+    __slots__ = ("_next", "_prev")
+
+    def __init__(self) -> None:
+        self._next: dict[int, int] = {}
+        self._prev: int | None = None
+
+    def observe(self, token: int) -> None:
+        """Fold one kept token into the table (in stream order)."""
+        token = int(token)
+        if self._prev is not None:
+            self._next[self._prev] = token
+        self._prev = token
+
+    def draft(self, cur: int, k: int) -> list[int]:
+        """Up to ``k`` proposed continuations of ``cur`` — shorter (or
+        empty) when the chain runs off the table's known transitions."""
+        out: list[int] = []
+        t = int(cur)
+        for _ in range(max(0, k)):
+            nt = self._next.get(t)
+            if nt is None:
+                break
+            out.append(nt)
+            t = nt
+        return out

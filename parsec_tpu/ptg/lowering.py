@@ -2146,6 +2146,47 @@ def _warm_workload(workload: str, n: int | None, nb: int | None):
         tp = decode_superpool_ptg(kv, Q, O, TOK, EMB, seqs,
                                   [ksteps] * nseqs, devices="auto")
         return tp, dict(nseqs=nseqs, steps=ksteps)
+    if workload == "llm_spec_k":
+        # the batched speculative superpool (ISSUE 12): n = sequences,
+        # nb = draft tokens per stream (1 + nb positions, the serving
+        # path's pad) — warming it AOT keeps the spec serving path
+        # (llm_spec_k > 0) from paying cold XLA at first-draft time in
+        # bench/tier-1
+        from ..data.datatype import TileType
+        from ..data_dist.collection import DictCollection
+        from ..data_dist.paged_kv import PagedKVCollection
+        from ..llm.decode import (preallocate_decode_steps,
+                                  seed_spec_batched, spec_batched_ptg)
+        from ..llm.model import ToyLM
+        nseqs, kdraft = n or 8, nb or 8
+        model = ToyLM()
+        kv = PagedKVCollection("KV", page_size=16,
+                               num_heads=model.num_heads,
+                               head_dim=model.head_dim)
+        H, D = kv.num_heads, kv.head_dim
+        QS = DictCollection("QS", dtt=TileType((kdraft + 1, 3, H, D),
+                                               np.float32))
+        LIM = DictCollection("LIM", dtt=TileType((kdraft + 1,),
+                                                 np.float32))
+        DTOKS = DictCollection("DTOKS", dtt=TileType((kdraft + 3,),
+                                                     np.float32))
+        VOUT = DictCollection("VOUT", dtt=TileType((kdraft + 3,),
+                                                   np.float32))
+        EMB = DictCollection("EMB", dtt=TileType(
+            model.q3_table().shape, np.float32))
+        seqs = [f"s{i}" for i in range(nseqs)]
+        for s in seqs:
+            kv.alloc_seq(s)
+            for _ in range(3):
+                kv.alloc_page(s)
+            kv.note_appended(s, 3 * kv.page_size - 1)
+            preallocate_decode_steps(kv, s, kdraft + 1)
+            seed_spec_batched(model, kv, QS, LIM, DTOKS, s, 0,
+                              list(range(1, kdraft + 1)), kdraft + 1)
+        tp = spec_batched_ptg(kv, QS, LIM, DTOKS, VOUT, EMB, seqs,
+                              [kdraft + 1] * nseqs, pad=kdraft + 1,
+                              devices="auto")
+        return tp, dict(nseqs=nseqs, draft=kdraft)
     if workload == "llm_prefill_tail":
         # the prefix-cache admission shape (ISSUE 11): streams whose
         # prompt matched the radix trie prefill only their unmatched
@@ -2178,7 +2219,7 @@ def _warm_workload(workload: str, n: int | None, nb: int | None):
         return tp, dict(nseqs=nseqs, tail_pages=tail_pages)
     raise ValueError(f"unknown warm workload {workload!r} (gemm, "
                      f"cholesky, lu, stencil, llm_decode, llm_decode_k, "
-                     f"llm_prefill_tail)")
+                     f"llm_spec_k, llm_prefill_tail)")
 
 
 def warm_cache(workload: str, n: int | None = None, nb: int | None = None,
@@ -2226,15 +2267,16 @@ def _main(argv: list[str] | None = None) -> int:
                     "budgets').")
     ap.add_argument("--warm", metavar="WORKLOAD", required=True,
                     help="gemm | cholesky | lu | stencil | llm_decode | "
-                         "llm_decode_k | llm_prefill_tail")
+                         "llm_decode_k | llm_spec_k | llm_prefill_tail")
     ap.add_argument("--n", type=int, default=None,
                     help="problem size (stencil: vector length; "
-                    "llm_decode/llm_decode_k/llm_prefill_tail: "
-                    "sequence count)")
+                    "llm_decode/llm_decode_k/llm_spec_k/"
+                    "llm_prefill_tail: sequence count)")
     ap.add_argument("--nb", type=int, default=None,
                     help="tile size (stencil: segment size; llm_decode: "
                     "pages per sequence; llm_decode_k: steps per "
-                    "superpool; llm_prefill_tail: tail pages)")
+                    "superpool; llm_spec_k: draft tokens per stream; "
+                    "llm_prefill_tail: tail pages)")
     ap.add_argument("--nt", type=int, default=None,
                     help="tile count (alternative to --n: n = nt * nb)")
     ap.add_argument("--modes", default="auto,region",

@@ -46,6 +46,14 @@ else
     python -m pytest tests/test_llm_prefix.py -q -k "trie or privatize" \
         -p no:cacheprovider
 
+    echo "== speculative decode unit tests (VERIFY incarnation trios," \
+         "spec pools vs the greedy oracle at acceptance 0/partial/1.0," \
+         "tail rollback across page boundaries + device-copy" \
+         "invalidation) =="
+    python -m pytest tests/test_llm_spec.py -q \
+        -k "incarnations or rollback or acceptance_sweep or rejected" \
+        -p no:cacheprovider
+
     echo "== llm microbench (smoke: tokens/s through the serving stack," \
          "swept over llm_steps_per_pool — superpool amortization) =="
     python -c 'import json, microbench; \

@@ -22,9 +22,13 @@ cd "$(dirname "$0")/.."
 # llm_prefill_tail is the prefix-cache admission shape (ISSUE 11): a
 # trie-hit stream prefills only its unmatched tail, and warming that
 # pool geometry keeps cache hits from paying cold compile at admission.
+# llm_spec_k is the batched speculative superpool (ISSUE 12): warming it
+# keeps the spec serving path (--mca llm_spec_k N) from hitting cold XLA
+# at first-draft time in bench/tier-1.
 WORKLOADS=("$@")
 if [[ ${#WORKLOADS[@]} -eq 0 ]]; then
-    WORKLOADS=(gemm cholesky lu stencil llm_decode_k llm_prefill_tail)
+    WORKLOADS=(gemm cholesky lu stencil llm_decode_k llm_spec_k
+               llm_prefill_tail)
 fi
 
 ARGS=()

@@ -580,23 +580,25 @@ def test_fork_from_retired_parent_falls_back_to_plain_prefill():
         assert server.stats()["llm"]["forked_streams"] == 0
 
 
-def test_fork_from_decoding_parent_falls_back_to_plain_prefill(param):
-    """The classification window: a child can be classified as a fork
-    while its live parent sits exactly at the prompt boundary, and the
-    SAME iteration's decode superpool then advances the parent before
-    the fork resolves.  The child must take the documented silent
-    fallback (its own plain prefill) — never a stream failure from
-    iteration timing."""
+def test_fork_from_decoding_parent_forks_early_or_falls_back(param):
+    """The classification window (ISSUE 12 closed most of it): a child
+    classified against a live parent sitting exactly at the prompt
+    boundary now forks AT CLASSIFICATION TIME — before the same
+    iteration's decode superpool can advance the parent — and CoW
+    privatizes the parent's next append away from the child's
+    snapshot.  A child that only classifies AFTER the parent advanced
+    still takes the documented silent fallback (its own plain
+    prefill).  Either way: oracle-exact tokens, never a stream failure
+    from iteration timing."""
     import time as _time
     param("llm_steps_per_pool", 2)
     prompt = [3, 7, 11, 5]
     with RuntimeServer(nb_cores=2) as server:
         t1 = server.submit_stream(prompt, max_new_tokens=6)
         deadline = _time.monotonic() + 60
-        # submit the child while the parent PREFILLS: it lands in the
-        # NEXT iteration's fresh batch, where the parent sits at its
-        # boundary (fork classification) until that iteration's own
-        # decode superpool advances it — the window under test
+        # submit the child while the parent PREFILLS: it lands in a
+        # LATER iteration's fresh batch, where the parent either still
+        # sits at its boundary (early fork) or has decoded (fallback)
         while t1.state == "queued":
             assert _time.monotonic() < deadline, "parent never admitted"
             _time.sleep(0.0002)
@@ -605,9 +607,9 @@ def test_fork_from_decoding_parent_falls_back_to_plain_prefill(param):
             MODEL.reference_generate(prompt, 6)
         assert t2.result(timeout=60)["tokens"] == \
             MODEL.reference_generate(prompt, 3)
-        # the parent was past its boundary by resolve time: sharing is
-        # an optimization, the fallback prefilled the child's own pages
-        assert server.stats()["llm"]["forked_streams"] == 0
+        # sharing is an optimization whose window depends on iteration
+        # timing: both resolutions are legal, failure is not
+        assert server.stats()["llm"]["forked_streams"] in (0, 1)
 
 
 def test_batcher_region_lowered_superpools_match_oracle(param):

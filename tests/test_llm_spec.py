@@ -1,0 +1,531 @@
+"""Speculative decode (ISSUE 12): the VERIFY kernel trio, both spec
+superpool incarnations (per-position predicated branches and the batched
+serving path), the paged-KV tail-rollback primitive, and the batcher's
+draft/verify/rollback loop with adaptive per-stream spec_k — everything
+gated token-for-token against the non-speculative greedy oracle at
+acceptance 0, partial, and 1.0 (``docs/LLM.md``)."""
+
+import numpy as np
+import pytest
+from unittest import mock
+
+from parsec_tpu.data.datatype import TileType
+from parsec_tpu.data_dist.collection import DictCollection
+from parsec_tpu.data_dist.paged_kv import PagedKVCollection
+from parsec_tpu.llm import (NgramDrafter, ToyLM, preallocate_decode_steps,
+                            read_spec_batched, read_spec_chain,
+                            seed_spec_batched_pool, seed_spec_superpool,
+                            spec_batched_ptg, spec_superpool_ptg)
+from parsec_tpu.llm.decode import prefill_chunks, seed_spec_batched
+from parsec_tpu.ops import ragged_attention as ra
+from parsec_tpu.runtime import Context
+from parsec_tpu.serve import RuntimeServer
+
+MODEL = ToyLM()
+H, D = MODEL.num_heads, MODEL.head_dim
+
+
+def _kv(page_size=4, **kw):
+    return PagedKVCollection("KV", page_size=page_size, num_heads=H,
+                             head_dim=D, **kw)
+
+
+class OracleDrafter(NgramDrafter):
+    """Drafts the TRUE continuation (acceptance 1.0): the observed
+    history IS the stream's prompt + kept tokens, so the reference
+    decode from it is exactly what the target will emit."""
+
+    def __init__(self):
+        self.hist = []
+
+    def observe(self, token):
+        self.hist.append(int(token))
+
+    def draft(self, cur, k):
+        assert self.hist and self.hist[-1] == int(cur)
+        return MODEL.reference_generate(self.hist, k)
+
+
+class GarbageDrafter(NgramDrafter):
+    """Always proposes WRONG tokens (acceptance 0): off-by-one of the
+    true continuation, padded to the full cap so every pool drafts."""
+
+    def __init__(self):
+        self.hist = []
+
+    def observe(self, token):
+        self.hist.append(int(token))
+
+    def draft(self, cur, k):
+        return [(t + 1) % MODEL.vocab
+                for t in MODEL.reference_generate(self.hist, k)]
+
+
+# ---------------------------------------------------------------------------
+# kernels: every incarnation agrees (the VERIFY trio, the batched pair)
+# ---------------------------------------------------------------------------
+
+def test_verify_step_incarnations_agree_and_predicate():
+    q3t = MODEL.q3_table()
+    o = MODEL.q3(13)[2]                       # any (H, D) activation
+    for st_prev in ([5.0, 1.0, 0.0, -1.0],    # live, no EOS
+                    [5.0, 1.0, 0.0, 7.0],     # live, EOS armed
+                    [5.0, 0.0, 0.0, 7.0],     # rejected: dead
+                    [5.0, 1.0, 1.0, 7.0]):    # done: dead
+        for dtok in (5.0, 6.0):
+            prev = np.array(st_prev, np.float32)
+            d = np.array([dtok], np.float32)
+            want = ra.verify_step_np(o, prev, d, q3t)
+            got = np.asarray(ra._verify_jnp(o, prev, d, q3t))
+            assert np.abs(got - want).max() < 1e-6, (st_prev, dtok)
+
+
+def test_verify_eos_inside_rejected_branch_is_invisible():
+    """An EOS the target would sample at a DEAD position (rejected
+    draft, or already done) must neither surface nor finish the
+    stream."""
+    q3t = MODEL.q3_table()
+    o = MODEL.q3(13)[2]
+    tok = ra.verify_step_np(o, np.array([5, 1, 0, -1], np.float32),
+                            np.array([5.0], np.float32), q3t)
+    eos = tok[0]                               # the token argmax yields
+    # same o, but the position is dead (prev live=0): the would-be EOS
+    # token is never examined — state holds, done stays 0
+    dead = ra.verify_step_np(o, np.array([5, 0, 0, eos], np.float32),
+                             np.array([5.0], np.float32), q3t)
+    assert dead[1] == 0.0 and dead[2] == 0.0 and dead[0] == 5.0
+    # at a LIVE position the same sample finishes the stream
+    live = ra.verify_step_np(o, np.array([5, 1, 0, eos], np.float32),
+                             np.array([5.0], np.float32), q3t)
+    assert live[1] == 1.0 and live[2] == 1.0 and live[0] == eos
+
+
+def test_spec_attn_page_incarnations_agree_with_serial_chain():
+    """The batched multi-query page update must equal S independent
+    single-query chains — including zero-limit (padded/empty) rows."""
+    tokens = [3, 7, 11, 5, 9, 2, 40]
+    page = np.zeros((3, 8, H, D), np.float32)
+    for i, t in enumerate(tokens):
+        q3 = MODEL.q3(t)
+        page[0, i], page[1, i] = q3[1], q3[2]
+    page[2, 0, 0, 0] = len(tokens)
+    S = 4
+    qs = np.zeros((S, 3, H, D), np.float32)
+    for i, t in enumerate((13, 22, 8)):
+        qs[i] = MODEL.q3(t)
+    lim = np.array([3, 7, 5, 0], np.float32)   # ragged causal limits
+    acc = np.zeros((S, H, D + 2), np.float32)
+    got = ra.spec_attn_page_np(qs, page, lim, acc)
+    gotj = np.asarray(ra._spec_attn_page_jnp(qs, page, lim, acc))
+    assert np.abs(got - gotj).max() < 1e-5
+    for s in range(3):                         # rows with live limits
+        pg = np.array(page)
+        pg[2, 0, 0, 0] = lim[s]                # single-query fill = limit
+        want = ra.attn_page_update_np(qs[s], pg,
+                                      np.zeros((H, D + 2), np.float32))
+        assert np.abs(got[s] - want).max() < 1e-5, s
+    # the padded (all-masked) row stays an EMPTY flash state: zero sum
+    # and denominator, so it finalizes to zeros (the running max is a
+    # NEG_INF sentinel there — equivalent, never read at l == 0)
+    assert np.abs(got[3][:, :D]).max() == 0.0
+    assert np.abs(got[3][:, D + 1]).max() == 0.0
+    assert np.abs(ra.finalize_acc_np(got[3])).max() == 0.0
+
+
+def test_spec_verify_incarnations_agree_across_acceptance():
+    q3t = MODEL.q3_table()
+    rng = np.random.default_rng(7)
+    S = 5
+    acc = rng.standard_normal((S, H, D + 2)).astype(np.float32)
+    acc[:, :, D + 1] = np.abs(acc[:, :, D + 1]) + 0.5
+    l = acc[:, :, D + 1]
+    o = acc[:, :, :D] / l[:, :, None]
+    tgt = np.argmax(o.reshape(S, -1) @ q3t[:, 0].reshape(
+        MODEL.vocab, -1).T, axis=1)
+    for chain, eos in (
+            ([9] + list(tgt[:4]), -1.0),       # full acceptance
+            ([9] + list(tgt[:2]) + [63, 63], -1.0),  # reject at pos 3
+            ([9, 63, 63, 63, 63], -1.0),       # reject at pos 1
+            ([9] + list(tgt[:4]), float(tgt[1])),    # EOS at live pos 1
+            ([9, 63, 63, 63, 63], float(tgt[2]))):   # EOS on dead pos
+        dt = np.zeros(S + 2, np.float32)
+        dt[0], dt[1] = S, eos
+        dt[2:2 + S] = chain
+        want = ra.spec_verify_np(acc, dt, q3t)
+        got = np.asarray(ra._spec_verify_jnp(acc, dt, q3t))
+        assert np.abs(got - want).max() < 1e-6, (chain, eos)
+
+
+# ---------------------------------------------------------------------------
+# the pools: acceptance sweep vs the oracle, both incarnations
+# ---------------------------------------------------------------------------
+
+def _run_general(prompts, drafts, eos=None):
+    kv = _kv()
+    DRAFT = DictCollection("DRAFT", dtt=TileType((3, H, D), np.float32))
+    O = DictCollection("O", dtt=TileType((H, D), np.float32))
+    STOK = DictCollection("STOK", dtt=TileType((4,), np.float32))
+    DTOK = DictCollection("DTOK", dtt=TileType((1,), np.float32))
+    EMB = DictCollection("EMB", dtt=TileType(MODEL.q3_table().shape,
+                                             np.float32))
+    npos = seed_spec_superpool(MODEL, kv, DRAFT, DTOK, STOK, EMB,
+                               prompts, drafts, eos=eos)
+    tp = spec_superpool_ptg(kv, DRAFT, O, STOK, DTOK, EMB, list(prompts),
+                            [npos[s] for s in prompts])
+    report = tp.validate()
+    assert not report.errors and not report.warnings, report
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+    return {s: read_spec_chain(STOK, s, npos[s]) for s in prompts}, kv
+
+
+def _run_batched(prompts, drafts, eos=None):
+    kv = _kv()
+    pad = max(len(d) for d in drafts.values()) + 1
+    QS = DictCollection("QS", dtt=TileType((pad, 3, H, D), np.float32))
+    LIM = DictCollection("LIM", dtt=TileType((pad,), np.float32))
+    DTOKS = DictCollection("DTOKS", dtt=TileType((pad + 2,), np.float32))
+    VOUT = DictCollection("VOUT", dtt=TileType((pad + 2,), np.float32))
+    EMB = DictCollection("EMB", dtt=TileType(MODEL.q3_table().shape,
+                                             np.float32))
+    npos, pad = seed_spec_batched_pool(MODEL, kv, QS, LIM, DTOKS, EMB,
+                                       prompts, drafts, pad=pad,
+                                       eos=eos)
+    tp = spec_batched_ptg(kv, QS, LIM, DTOKS, VOUT, EMB, list(prompts),
+                          [npos[s] for s in prompts], pad=pad)
+    report = tp.validate()
+    assert not report.errors and not report.warnings, report
+    with Context(nb_cores=0) as ctx:
+        ctx.add_taskpool(tp)
+        ctx.wait(timeout=120)
+    return {s: read_spec_batched(VOUT, s) for s in prompts}, kv
+
+
+@pytest.mark.parametrize("run", [_run_general, _run_batched],
+                         ids=["general", "batched"])
+def test_spec_pool_acceptance_sweep_matches_oracle(run):
+    """Acceptance 1.0, partial, and 0 — all token-for-token equal to the
+    greedy oracle: full drafts emit every position, a mid-chain
+    mismatch emits exactly the accepted prefix + the correction token,
+    garbage emits position 0 only."""
+    prompts = {"a": [3, 7, 11, 5], "b": [1, 40]}
+    ref = {s: MODEL.reference_generate(p, 6) for s, p in prompts.items()}
+    full = {s: ref[s][:5] for s in prompts}
+    got, _ = run(prompts, full)
+    for s in prompts:
+        assert got[s][0] == ref[s][:6], (s, got[s])
+    partial = {s: ref[s][:2] + [(ref[s][2] + 1) % 64,
+                                (ref[s][3] + 7) % 64] for s in prompts}
+    got, _ = run(prompts, partial)
+    for s in prompts:
+        assert got[s][0] == ref[s][:3], (s, got[s])
+    garbage = {s: [(t + 1) % 64 for t in ref[s][:5]] for s in prompts}
+    got, _ = run(prompts, garbage)
+    for s in prompts:
+        assert got[s][0] == ref[s][:1], (s, got[s])
+
+
+@pytest.mark.parametrize("run", [_run_general, _run_batched],
+                         ids=["general", "batched"])
+def test_spec_pool_eos_in_live_vs_rejected_branch(run):
+    """EOS at a LIVE position truncates there (done); the same stream
+    with the EOS position already rejected must emit the pre-rejection
+    prefix with done=False — an EOS inside a rejected branch never
+    finishes the stream."""
+    prompt = [3, 7, 11, 5]
+    ref = MODEL.reference_generate(prompt, 6)
+    eos = ref[2]
+    want = MODEL.reference_generate(prompt, 6, eos=eos)
+    assert 1 <= len(want) < 6 and ref[0] != eos
+    toks, done = run({"a": prompt}, {"a": ref[:5]}, eos=eos)[0]["a"]
+    assert toks == want and done             # EOS kept, chain cut there
+    # reject position 1: positions 1.. are dead, incl. the EOS position
+    bad = [(ref[0] + 1) % 64] + ref[1:5]
+    toks, done = run({"a": prompt}, {"a": bad}, eos=eos)[0]["a"]
+    assert toks == ref[:1] and not done
+
+
+# ---------------------------------------------------------------------------
+# rollback_tail: the version-jump truncation primitive
+# ---------------------------------------------------------------------------
+
+def test_rollback_tail_across_page_boundary_and_ledger():
+    kv = _kv(page_size=4)
+    kv.alloc_seq("s")
+    for key, tile in prefill_chunks(MODEL, kv, "s",
+                                    [3, 7, 11]).items():
+        pg = kv.data_of(*key).get_copy(0)
+        pg.value = np.array(tile, copy=True)
+        pg.version += 1
+    # speculative appends: 5 positions from token 3 -> slots 3..7,
+    # crossing from page 0 into page 1 (staged manually — this test is
+    # about rollback, not seeding)
+    preallocate_decode_steps(kv, "s", 5)
+    for t in range(5):
+        pg, slot = divmod(3 + t, 4)
+        c = kv.data_of("s", pg).get_copy(0)
+        c.value[0, slot] = 1.0 + t
+        c.value[2, 0, 0, 0] = min(4, 3 + 5 - pg * 4)
+        c.version += 1
+    kv.note_appended("s", 5)
+    assert kv.seq_len("s") == 8
+    # roll back to 5 tokens: page 1 keeps 1 slot, page 0 untouched
+    rolled = kv.rollback_tail("s", 5)
+    assert rolled == 3
+    assert kv.seq_len("s") == 5
+    p1 = np.asarray(kv.data_of("s", 1).newest_copy().value)
+    assert p1[2, 0, 0, 0] == 1                 # boundary fill truncated
+    assert p1[0, 0, 0, 0] == 2.0               # kept slot preserved
+    assert np.abs(p1[0, 1:]).max() == 0.0      # scrubbed slots zeroed
+    p0 = np.asarray(kv.data_of("s", 0).newest_copy().value)
+    assert p0[2, 0, 0, 0] == 4                 # full page untouched
+    s = kv.stats()
+    assert s["tail_rollbacks"] == 1 and s["slots_rolled_back"] == 3
+    # bounds are enforced
+    with pytest.raises(ValueError):
+        kv.rollback_tail("s", 6)
+    with pytest.raises(ValueError):
+        kv.rollback_tail("s", -1)
+
+
+def test_rollback_tail_invalidates_stale_device_copies():
+    """The recycle-detach discipline (PR 11) extended to rollback: a
+    dirty device copy holding the rejected speculative appends must
+    never satisfy a later stage-in version check."""
+    from parsec_tpu.data.data import DataCopy
+    kv = _kv(page_size=4)
+    kv.alloc_seq("s")
+    kv.alloc_page("s")
+    kv.note_appended("s", 3)
+    d = kv.data_of("s", 0)
+    dev = DataCopy(d, 1, value=np.ones(kv.default_dtt.shape, np.float32))
+    dev.version = d.get_copy(0).version + 5      # device runs ahead
+    d.attach_copy(dev)
+    kv.rollback_tail("s", 1)
+    assert d.get_copy(1) is None                 # detached
+    host = d.get_copy(0)
+    assert host.version > dev.version            # version jumped past
+    assert np.asarray(host.value)[2, 0, 0, 0] == 1
+    assert kv.seq_len("s") == 1
+
+
+def test_seed_staging_invalidates_stale_device_copies():
+    """Seed-time speculative staging rides the same recycle-detach
+    discipline (code-review finding): a dirty device copy running
+    ahead of host must be detached and the staged host bytes must
+    version-jump past it — otherwise a deferred device writeback would
+    silently clobber the staged draft k/v and regress the version."""
+    from parsec_tpu.data.data import DataCopy
+    kv = _kv(page_size=4)
+    pad = 4
+    QS = DictCollection("qs", dtt=TileType((pad, 3, H, D), np.float32))
+    LIM = DictCollection("lim", dtt=TileType((pad,), np.float32))
+    DTOKS = DictCollection("dt", dtt=TileType((pad + 2,), np.float32))
+    kv.alloc_seq("s")
+    kv.alloc_page("s")
+    kv.note_appended("s", 2)
+    d = kv.data_of("s", 0)
+    dev = DataCopy(d, 1, value=np.full(kv.default_dtt.shape, 7.0,
+                                       np.float32))
+    dev.version = d.get_copy(0).version + 3      # device runs ahead
+    d.attach_copy(dev)
+    preallocate_decode_steps(kv, "s", 3)
+    seed_spec_batched(MODEL, kv, QS, LIM, DTOKS, "s", 5, [9, 2], pad)
+    assert d.get_copy(1) is None                 # detached
+    host = d.get_copy(0)
+    assert host.version > dev.version            # jumped past
+    # the staged bytes sourced the NEWEST copy (the device one)
+    assert np.asarray(host.value)[0, 0, 0, 0] == 7.0
+    assert np.asarray(host.value)[2, 0, 0, 0] == 4  # staged fill
+
+
+def test_rollback_tail_refuses_shared_pages():
+    """Rollback into a CoW-shared page means the ledger and block table
+    disagree — fail loudly instead of corrupting the sibling."""
+    kv = _kv(page_size=4)
+    kv.alloc_seq("p")
+    kv.alloc_page("p")
+    kv.note_appended("p", 4)
+    kv.fork("p", "c")
+    with pytest.raises(RuntimeError, match="shared"):
+        kv.rollback_tail("c", 2)
+
+
+# ---------------------------------------------------------------------------
+# the batcher: draft/verify/rollback end to end, adaptive spec_k
+# ---------------------------------------------------------------------------
+
+def _serve_all(prompts, max_new, drafter_cls=None, eos=None, tenant_fn=None,
+               nb_cores=2):
+    patch = mock.patch("parsec_tpu.llm.batcher.NgramDrafter",
+                       drafter_cls) if drafter_cls else None
+    if patch:
+        patch.start()
+    try:
+        with RuntimeServer(nb_cores=nb_cores) as server:
+            tks = [server.submit_stream(
+                p, max_new_tokens=max_new, eos=eos,
+                tenant=tenant_fn(i) if tenant_fn else "t")
+                for i, p in enumerate(prompts)]
+            outs = [tk.result(timeout=300)["tokens"] for tk in tks]
+            stats = server.stats()["llm"]
+            metrics = server.metrics()
+        return outs, stats, metrics, tks
+    finally:
+        if patch:
+            patch.stop()
+
+
+@pytest.mark.parametrize("drafter,accept", [
+    (OracleDrafter, 1.0), (NgramDrafter, None), (GarbageDrafter, 0.0)],
+    ids=["accept-1.0", "accept-partial", "accept-0"])
+def test_batcher_spec_acceptance_sweep_matches_oracle(param, drafter,
+                                                      accept):
+    """The ISSUE-12 acceptance-criteria sweep at the serving layer:
+    whatever the drafter's quality, every stream is token-for-token
+    the non-speculative greedy oracle — a rejected token or stale
+    rolled-back KV surfacing anywhere breaks equality."""
+    param("llm_spec_k", 6)
+    param("llm_spec_adaptive", False)
+    prompts = [[3, 7, 11, 5], [1, 40], [8, 8, 2, 6], [5, 9]]
+    outs, stats, _, _ = _serve_all(prompts, 14, drafter_cls=drafter)
+    for p, o in zip(prompts, outs):
+        assert o == MODEL.reference_generate(p, 14), (p, o)
+    assert stats["spec_submits"] > 0, stats
+    if accept is not None:
+        assert stats["spec_accept_rate"] == accept, stats
+    if accept == 0.0:
+        # every drafted position was rejected and rolled back
+        assert stats["kv"]["tail_rollbacks"] == stats["spec_submits"]
+        assert stats["spec_tokens"] == stats["spec_submits"]
+
+
+def test_batcher_spec_eos_mid_draft_matches_truncated_oracle(param):
+    param("llm_spec_k", 8)
+    param("llm_spec_adaptive", False)
+    ref = MODEL.reference_generate([3, 7, 11, 5], 16)
+    eos = ref[5]
+    want = MODEL.reference_generate([3, 7, 11, 5], 16, eos=eos)
+    assert 1 <= len(want) < 16
+    outs, stats, _, _ = _serve_all([[3, 7, 11, 5], [1, 40]], 16,
+                                   drafter_cls=OracleDrafter, eos=eos)
+    assert outs[0] == want
+    assert outs[1] == MODEL.reference_generate([1, 40], 16, eos=eos)
+    assert stats["kv"]["physical_pages"] == 0
+
+
+def test_batcher_spec_over_trie_forked_prefix(param):
+    """Spec decode composes with the PR-11 radix-tree prefix cache: a
+    trie adoptee's CoW prompt pages feed the spec pool's frozen-page
+    reads, its speculative tail stays private, and tokens stay
+    oracle-exact."""
+    param("llm_spec_k", 8)
+    param("llm_prefix_cache", True)
+    shared = [(5 * i + 11) % 64 for i in range(40)]
+    with RuntimeServer(nb_cores=2) as server:
+        donor = server.submit_stream(shared + [3], max_new_tokens=1,
+                                     tenant="p")
+        donor.result(timeout=120)         # retires -> donates the prefix
+        tks = [server.submit_stream(shared + [3], max_new_tokens=12,
+                                    tenant="p") for _ in range(3)]
+        want = MODEL.reference_generate(shared + [3], 12)
+        for tk in tks:
+            assert tk.result(timeout=120)["tokens"] == want
+        llm = server.stats()["llm"]
+        assert llm["kv"]["prefix_hits"] >= 3, llm["kv"]
+        assert llm["spec_submits"] > 0, llm
+
+
+def test_batcher_spec_with_fork_on_prompt(param):
+    """Spec decode composes with fork_from= CoW prompt sharing: the
+    fork children's speculative tails privatize away from the shared
+    prompt pages and every fork matches the oracle."""
+    param("llm_spec_k", 6)
+    prompt = list(range(1, 41))
+    with RuntimeServer(nb_cores=2) as server:
+        t1 = server.submit_stream(prompt, max_new_tokens=8)
+        t2 = server.submit_stream(prompt, max_new_tokens=8, fork_from=t1)
+        want = MODEL.reference_generate(prompt, 8)
+        assert t1.result(timeout=120)["tokens"] == want
+        assert t2.result(timeout=120)["tokens"] == want
+        assert server.stats()["llm"]["kv"]["physical_pages"] == 0
+
+
+def test_adaptive_spec_k_converges_off_on_garbage_and_stays_cheap(param):
+    """Acceptance-rate-0 pathological traffic: the adaptive controller
+    must converge every stream's spec_k to ~0 (the non-speculative
+    fallback), the tenant prior must spare LATER streams the descent,
+    and the structural cost must stay near the PR-9 path (submits
+    within 10% once converged)."""
+    param("llm_spec_k", 16)
+    param("llm_spec_adaptive", True)
+    prompts = [[(7 * i + 3 * j) % 64 for j in range(8)]
+               for i in range(4)]
+    outs, stats, _, tks = _serve_all(prompts, 64,
+                                     drafter_cls=GarbageDrafter)
+    for p, o in zip(prompts, outs):
+        assert o == MODEL.reference_generate(p, 64), p
+    assert stats["spec_accept_rate"] == 0.0, stats
+    # every stream converged off (<= 1 means effectively non-spec)
+    assert all((tk.spec_k or 0) <= 1 for tk in tks), \
+        [tk.spec_k for tk in tks]
+    # structural throughput proxy: with k=8 pools the non-spec path
+    # needs ceil(64/8)=8 submits per stream; the descent costs a few
+    # 1-token spec pools up front, the prior spares later streams —
+    # in total within ~10% + the bounded descent overhead
+    nonspec_submits = 8 * len(prompts)
+    assert stats["decode_submits"] <= nonspec_submits * 1.1 + 6, stats
+    # a second wave on the SAME server would start off thanks to the
+    # tenant prior; approximated here by the cumulative accept rate
+    # staying pinned at 0 with only log2(16)-ish spec pools ever run
+    assert stats["spec_submits"] <= 6 * len(prompts), stats
+
+
+def test_spec_metrics_surface_in_slo_plane_and_runtime_report(param):
+    """The satellite surfacing contract: per-tenant spec_accept_rate /
+    spec_tokens_per_submit histograms in RuntimeServer.metrics(), the
+    cumulative counter pair in batcher stats and in
+    runtime_report()["llm"] — surviving batcher retirement."""
+    from parsec_tpu.prof.flight_recorder import runtime_report
+    import parsec_tpu.llm.batcher as batcher_mod
+    param("llm_spec_k", 6)
+    param("llm_spec_adaptive", False)
+    before = dict(batcher_mod._retired_totals)
+    prompts = [[3, 7, 11, 5], [1, 40]]
+    outs, stats, metrics, _ = _serve_all(
+        prompts, 12, drafter_cls=OracleDrafter,
+        tenant_fn=lambda i: f"ten{i}")
+    for p, o in zip(prompts, outs):
+        assert o == MODEL.reference_generate(p, 12), p
+    assert stats["spec_accept_rate"] == 1.0
+    assert stats["spec_tokens_per_submit"] > 1.0
+    for i in range(len(prompts)):
+        ten = metrics["tenants"][f"ten{i}"]
+        assert ten["spec_accept_rate_count"] > 0, ten
+        assert ten["spec_tokens_per_submit_count"] > 0, ten
+        assert ten["spec_tokens_per_submit_p50"] > 1.0, ten
+    # the server drained above -> the batcher retired -> its counters
+    # folded into the process-cumulative report block
+    rep = runtime_report()["llm"]
+    d_tokens = rep["spec_tokens"] - before.get("spec_tokens", 0)
+    assert d_tokens >= stats["spec_tokens"], (rep, stats)
+    assert rep["spec_accept_rate"] > 0.0
+    assert rep["spec_tokens_per_submit"] > 0.0
+
+
+def test_spec_speedup_on_draftable_workload_vs_nonspec(param):
+    """A coarse in-suite sanity of the ISSUE-12 speedup claim (the real
+    gate is perf_smoke's LLM_SPEC_SPEEDUP_MIN on bench_llm's spec
+    axis): on a draftable workload the spec path must emit multiple
+    tokens per submit — structurally impossible for the PR-9 path at
+    the same k."""
+    param("llm_spec_k", 16)
+    param("llm_spec_adaptive", True)
+    prompts = [[(3 * j) % 64 for j in range(8)],
+               [(60 + j) % 64 for j in range(8)]]
+    outs, stats, _, _ = _serve_all(prompts, 48)
+    for p, o in zip(prompts, outs):
+        assert o == MODEL.reference_generate(p, 48), p
+    assert stats["spec_tokens_per_submit"] >= 4.0, stats
+    assert stats["spec_accept_rate"] >= 0.5, stats

@@ -67,6 +67,15 @@ LLM_SUPERPOOL_SPEEDUP_MIN = 1.8
 # absolute threshold would)
 LLM_PREFIX_TTFT_SPEEDUP_MIN = 2.0
 LLM_PREFIX_SKIPPED_FRAC_MIN = 0.8
+# ISSUE-12 speculative decode: the adaptive drafter on the draftable
+# (repetitive) 8-stream workload must beat the PR-9 k=8 path of the
+# SAME workload >= 1.5x (measured ~1.6-1.9x on the smoke shape: the
+# batched spec superpool collapses ~k*NP+2k tasks per pool to NP+1 and
+# emits up to spec_k+1 tokens per submit), and acceptance-rate-0
+# traffic (garbage drafts) must converge spec_k to ~0 and stay within
+# 10% of the non-speculative path — the second gate lives in
+# tests/test_llm_spec.py where the drafter can be forced adversarial
+LLM_SPEC_SPEEDUP_MIN = 1.5
 
 
 def test_compiled_dispatch_latency():
@@ -139,14 +148,21 @@ def test_comm_overlap_efficiency_threshold(comm_numbers):
         COMM_OVERLAP_EFFICIENCY_MIN, comm_numbers
 
 
-def test_llm_decode_throughput_and_latency():
+@pytest.fixture(scope="module")
+def llm_numbers():
+    """One bench_llm run shared by the decode-throughput and
+    speculative-decode gates (the spec axis rides the same bench)."""
+    return microbench.bench_llm(smoke=True)
+
+
+def test_llm_decode_throughput_and_latency(llm_numbers):
     """The LLM serving path (ISSUE 6 + 9): k-step decode superpools over
     the paged KV cache on a hot RuntimeServer must sustain tokens/s with
     bounded per-token p99, and the superpool amortization (one submit
     per k tokens, in-graph SAMPLE) must hold against the k=1 baseline
     measured in the same run — tier-1's guard on the decode critical
     path (admission + WFQ + live enqueue + ragged ATTN chains)."""
-    r = microbench.bench_llm(smoke=True)
+    r = llm_numbers
     assert r["llm_tokens_per_s"] >= LLM_TOKENS_PER_S_MIN, r
     assert r["llm_p99_ms"] <= LLM_P99_MS_MAX, r
     # the sweep axes are really swept: all points present and sane
@@ -162,6 +178,32 @@ def test_llm_decode_throughput_and_latency():
     assert ksweep["8"]["submits_per_token"] <= 1.0 / 8 + 1e-9, r
     assert ksweep["1"]["submits_per_token"] > ksweep["8"][
         "submits_per_token"], r
+
+
+def test_llm_spec_decode_speedup(llm_numbers):
+    """The ISSUE-12 speculative-decode gate: on the draftable 8-stream
+    workload the adaptive drafter must beat the non-speculative PR-9
+    k=8 path of the SAME workload >= 1.5x, with a real acceptance rate
+    behind it (a dead drafter, a VERIFY that rejects everything, or a
+    spec pool that quietly serializes again all fail here by name).
+    The ratio is work-structural — both points run back to back in one
+    process — so it carries less timing noise than an absolute
+    threshold would."""
+    r = llm_numbers
+    sweep = r["llm_spec_sweep"]
+    assert set(sweep) == {"off", "2", "4", "adaptive"}, r
+    assert all(v["tokens_per_s"] > 0 for v in sweep.values()), r
+    assert r["llm_spec_speedup"] >= LLM_SPEC_SPEEDUP_MIN, r
+    # the speedup must come from accepted drafts, not a measurement
+    # artifact: the adaptive point's acceptance is real and its pools
+    # carry more tokens per submit than the fixed-2 point's cap allows
+    assert sweep["adaptive"]["accept_rate"] >= 0.3, r
+    assert sweep["adaptive"]["tokens_per_submit"] > \
+        sweep["2"]["tokens_per_submit"], r
+    # (zero rollbacks is legitimate here — on a fully draftable
+    # workload the transition phase drafts nothing rather than drafts
+    # wrong; forced-rejection rollback coverage lives in
+    # tests/test_llm_spec.py where the drafter is made adversarial)
 
 
 def test_llm_prefix_cache_ttft_speedup():
