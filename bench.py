@@ -654,6 +654,45 @@ def bench_comm_stage() -> dict:
     return out
 
 
+def bench_comm_ranks_stage() -> dict:
+    """The collective-tree rank sweep (ISSUE 14): one staged broadcast
+    + one tree reduction per rank count, across real subprocess ranks
+    (``run_multiproc``).  Emits the worst-rank broadcast/reduce latency
+    and the ROOT's egress bytes — the number the tree exists to bound:
+    ~⌈log₂ n⌉ payload transfers instead of n-1.  Each completed rank
+    count flushes through ``_note_partial`` so a deadline death keeps
+    the finished points."""
+    import os
+
+    from parsec_tpu.comm.multiproc import run_multiproc
+    from parsec_tpu.core.params import params as _p
+
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    sweep = [2, 4] if smoke else [2, 4, 8]
+    payload = int(_p.get("comm_coll_bench_bytes"))
+    out: dict = {"gflops": 0.0, "payload_bytes": payload,
+                 "tree": _p.get("comm_bcast_tree")}
+    for nranks in sweep:
+        res = run_multiproc(
+            nranks, "parsec_tpu.comm.collectives:_mp_collective_body",
+            timeout=240, nb_cores=1)
+        digests = {r["digest"] for r in res}
+        root_tx = res[0]["peer_stats"].get("tx", {})
+        egress = sum(d["bytes"] for d in root_tx.values())
+        point = {
+            f"bcast_{nranks}r_s": round(max(r["bcast_s"] for r in res), 4),
+            f"reduce_{nranks}r_s": round(max(r["reduce_s"] for r in res),
+                                         4),
+            f"root_egress_{nranks}r_bytes": egress,
+            f"root_egress_{nranks}r_payloads": round(
+                egress / payload, 2) if payload else 0.0,
+            f"bcast_{nranks}r_identical": len(digests) == 1,
+        }
+        out.update(point)
+        _note_partial(phase="measure", ranks_done=nranks, **point)
+    return out
+
+
 def bench_serve_stage() -> dict:
     """The serving-path stage: sustained concurrent submissions/s and
     p50/p99 ticket latency through a hot RuntimeServer (microbench.py's
@@ -953,6 +992,11 @@ def main() -> None:
                 "comm": {k: v for k, v in
                          res.get("comm", {}).items()
                          if k not in ("runtime_report", "gflops")},
+                # the collective-tree rank sweep: bcast/reduce latency +
+                # measured root egress per rank count (ISSUE 14)
+                "comm_ranks": {k: v for k, v in
+                               res.get("comm_ranks", {}).items()
+                               if k not in ("runtime_report", "gflops")},
                 # the serving stage: submissions/s, ticket latency, and
                 # the warm-vs-cold lowered split (ISSUE 3)
                 "serve": {k: v for k, v in
@@ -1089,6 +1133,10 @@ def main() -> None:
     # but never ahead of the headline (the round-4 ordering lesson) ---
     stage("serve", bench_serve_stage, timeout=150.0)
     stage("llm", bench_llm_stage, timeout=150.0)
+    # the collective-tree rank sweep spawns subprocess ranks — CPU-safe
+    # but slow, so it rides the secondary group, never ahead of the
+    # headline
+    stage("comm_ranks", bench_comm_ranks_stage, timeout=600.0)
     from parsec_tpu.models.stencil import run_stencil_bench
     stage("stencil", run_stencil_bench, timeout=60.0, **cfg["stencil"])
     stage("lowered_cholesky", bench_lowered_cholesky_gflops,

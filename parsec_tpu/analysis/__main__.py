@@ -134,6 +134,13 @@ def _model_graphs(nt: int):
         kv4, QS, LIM, DTOKS, VOUT, EMB3, list(prompts),
         [npos_b[s] for s in prompts], pad=pad)
 
+    # the collective-tree pools (ISSUE 14, comm/collectives.py): the
+    # staged broadcast's RW relay fan-out and the combining reduction's
+    # per-slot guarded partial flows, at the default tree shape
+    from ..comm.collectives import bcast_taskpool, reduce_taskpool
+    yield "comm_bcast", bcast_taskpool(_vec("CB"), n=nt)
+    yield "comm_reduce", reduce_taskpool(_vec("CR"), _vec("CO"), n=nt)
+
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
@@ -145,7 +152,8 @@ def main(argv: list[str] | None = None) -> int:
                          "pingpong, reduction, stencil1d, stencil2d, "
                          "tiled_gemm, all2all, llm_prefill, llm_decode, "
                          "llm_decode_k, llm_decode_spec, "
-                         "llm_decode_spec_batched) or a .jdf path")
+                         "llm_decode_spec_batched, comm_bcast, "
+                         "comm_reduce) or a .jdf path")
     ap.add_argument("--bind", action="append", default=[],
                     metavar="NAME=INT", help="JDF global binding")
     ap.add_argument("--nt", type=int, default=5,

@@ -31,7 +31,10 @@ Rebuild of the reference's communication stack (SURVEY §2.6, §3.4, §5.8):
 from . import codec
 from .engine import (AM_TAG_ACTIVATE, AM_TAG_GET_ACK, AM_TAG_TERMDET,
                      CommEngine, InprocFabric, MemHandle)
-from .remote_dep import RemoteDepEngine, RemoteDeps
+from .remote_dep import (RemoteDepEngine, RemoteDeps, TREE_KINDS,
+                         tree_children, tree_parent)
+from .collectives import (bcast_taskpool, reduce_taskpool,
+                          register_reduce_op, reduce_op)
 from .multirank import run_multirank
 from .multiproc import run_multiproc
 from .device_socket import DeviceSocketCommEngine
@@ -42,4 +45,6 @@ __all__ = [
     "RemoteDeps", "FourCounterTermDet", "run_multirank", "run_multiproc",
     "DeviceSocketCommEngine", "AM_TAG_ACTIVATE",
     "AM_TAG_GET_ACK", "AM_TAG_TERMDET", "codec",
+    "TREE_KINDS", "tree_children", "tree_parent",
+    "bcast_taskpool", "reduce_taskpool", "register_reduce_op", "reduce_op",
 ]

@@ -378,7 +378,7 @@ class InprocCommEngine(CommEngine):
     #  source replies with the payload, parsec_mpi_funnelled.c:247,980)
     def get(self, rwire: tuple[int, int],
             on_complete: Callable[[Any], None],
-            trace: int | None = None) -> None:
+            trace: int | None = None) -> int:
         owner, handle_id = rwire
         get_id = next(self._get_ids)
         self._pending_gets[get_id] = on_complete
@@ -389,6 +389,37 @@ class InprocCommEngine(CommEngine):
         if trace:
             msg["trace"] = trace
         self.send_am(AM_TAG_GET_REQ, owner, msg, trace_id=trace or 0)
+        return get_id
+
+    def resume_get(self, rwire: tuple[int, int], get_id: int,
+                   trace: int | None = None) -> bool:
+        """Re-issue a still-pending GET against a (possibly different)
+        owner — the mid-tree fault path: a staging parent died with the
+        transfer partially landed, so the requester pulls the REMAINDER
+        from a surviving holder (typically the grandparent).  Offsets
+        already in the landing zone ride a ``resume`` list on the GET
+        request; the new server skips them, and any zombie fragments the
+        dead parent managed to emit dedup against ``zone.landed`` exactly
+        once.  Returns False when the get already completed (nothing to
+        resume)."""
+        owner, handle_id = rwire
+        if get_id not in self._pending_gets:
+            return False
+        with self._frag_lock:
+            zone = self._landing.get(get_id)
+            resume = sorted(zone.landed) if zone is not None else []
+            if zone is not None:
+                # retarget the zone BEFORE any on_peer_failed(dead parent)
+                # sweep: a zone pointing at the dead src would be reaped
+                zone.src = owner
+        msg = {"handle": handle_id, "get_id": get_id,
+               "reply_to": self.rank}
+        if resume:
+            msg["resume"] = resume
+        if trace:
+            msg["trace"] = trace
+        self.send_am(AM_TAG_GET_REQ, owner, msg, trace_id=trace or 0)
+        return True
 
     def _record_get_span(self, get_id: int, nbytes: int) -> None:
         """Requester-side "comm.get" span: request sent -> payload
@@ -412,6 +443,19 @@ class InprocCommEngine(CommEngine):
         value = self._serve_value(h)
         plan = self._plan_frags(value)
         trace = msg.get("trace") or 0
+        landed = set(msg.get("resume") or ())
+        if plan is not None and landed:
+            # resumed pull: serve only the offsets the requester is still
+            # missing (its landing zone keeps what the dead parent shipped)
+            pieces, meta = plan
+            pieces = [p for p in pieces if p[0] not in landed]
+            if not pieces:
+                # everything already landed on the requester's side; its
+                # zone completes off in-flight fragments — just drop the
+                # share this pull would have consumed
+                self.mem_release(msg["handle"], peer=msg["reply_to"])
+                return
+            plan = (pieces, meta)
         if plan is not None:
             # large payload: windowed fragmented reply — the receiver
             # copies fragments into its own preallocated destination, so
@@ -439,6 +483,12 @@ class InprocCommEngine(CommEngine):
         self.mem_release(msg["handle"], peer=msg["reply_to"])
 
     def _finish_get(self, eng: CommEngine, src: int, msg: dict) -> None:
+        with self._frag_lock:
+            # a resumed GET answered monolithically (the new owner's frag
+            # params differ) supersedes any half-landed zone: retire it or
+            # _frag_active would stay pinned forever
+            if self._landing.pop(msg["get_id"], None) is not None:
+                self._frag_active -= 1
         cb = self._pending_gets.pop(msg["get_id"], None)
         if cb is None:
             # duplicate reply (e.g. a transport-level replay after a

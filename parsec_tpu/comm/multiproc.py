@@ -111,7 +111,8 @@ def _run_multiproc(nranks: int, target: str, timeout: float,
     from ..core.params import params as _p
     for name in ("comm_wire_binary", "comm_get_frag_bytes",
                  "comm_get_window", "comm_socket_buf_bytes",
-                 "comm_codec_pickle_fallback"):
+                 "comm_codec_pickle_fallback", "comm_bcast_tree",
+                 "comm_coll_bench_bytes"):
         env.setdefault(f"PARSEC_MCA_{name}", str(_p.get(name)))
     env["PARSEC_MP_NRANKS"] = str(nranks)
     env["PARSEC_MP_TARGET"] = target

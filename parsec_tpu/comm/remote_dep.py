@@ -168,7 +168,17 @@ def _packed_trace(m: Any) -> int:
     return 0
 
 
+TREE_KINDS = ("binomial", "chain", "star")
+
+
+def _check_tree_kind(kind: str) -> None:
+    if kind not in TREE_KINDS:
+        from ..core.params import MCAParamValueError
+        raise MCAParamValueError("comm_bcast_tree", kind, TREE_KINDS)
+
+
 def tree_children(kind: str, position: int, n: int) -> list[int]:
+    _check_tree_kind(kind)
     if n <= 1:
         return []
     if kind == "star":
@@ -184,6 +194,20 @@ def tree_children(kind: str, position: int, n: int) -> list[int]:
         out.append(position + j)
         j <<= 1
     return out
+
+
+def tree_parent(kind: str, position: int, n: int) -> int | None:
+    """The inverse of :func:`tree_children`: the position that re-serves
+    payloads to ``position`` (``None`` for the root).  Binomial parent =
+    the position with its most-significant set bit cleared."""
+    _check_tree_kind(kind)
+    if position <= 0 or n <= 1:
+        return None
+    if kind == "star":
+        return 0
+    if kind == "chain":
+        return position - 1
+    return position & ~(1 << (position.bit_length() - 1))
 
 
 # ---------------------------------------------------------------------------

@@ -29,6 +29,22 @@ _TYPES: dict[str, Callable[[str], Any]] = {
 }
 
 
+class MCAParamValueError(ValueError):
+    """A registered MCA param holds (or was handed) a value outside its
+    legal domain.  Raised at the point of *use* so the failing knob is
+    named with its full legal set — string-enum params (``comm_bcast_tree``
+    and friends) cannot be range-checked by the type system, so silent
+    fallthrough to a default is the failure mode this replaces."""
+
+    def __init__(self, name: str, value: Any, allowed) -> None:
+        self.param = name
+        self.value = value
+        self.allowed = tuple(allowed)
+        super().__init__(
+            f"MCA param {name}={value!r}: expected one of "
+            f"{sorted(self.allowed)}")
+
+
 @dataclass
 class Param:
     name: str

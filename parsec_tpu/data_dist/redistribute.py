@@ -17,9 +17,17 @@ taskpool is the general path.
 
 from __future__ import annotations
 
+import numpy as np
 
-from ..dtd.insert import DTDTaskpool, INPUT, INOUT, VALUE
-from .matrix import TiledMatrix
+from ..core.params import params as _params
+from ..dtd.insert import AFFINITY, DTDTaskpool, INPUT, INOUT, VALUE
+from .matrix import HashDataDist, TiledMatrix
+
+_params.register("redist_collective_fanout", True,
+                 "stage source tiles with >= 2 remote consumer ranks "
+                 "along the comm_bcast_tree relay tree (comm/collectives "
+                 "semantics) instead of serving every consumer pairwise "
+                 "from the owner")
 
 
 def _overlaps(lo_a: int, hi_a: int, lo_b: int, hi_b: int) -> tuple | None:
@@ -29,6 +37,10 @@ def _overlaps(lo_a: int, hi_a: int, lo_b: int, hi_b: int) -> tuple | None:
 
 def _copy_frag(dst_arr, src_arr, dr0, dr1, dc0, dc1, sr0, sr1, sc0, sc1):
     dst_arr[dr0:dr1, dc0:dc1] = src_arr[sr0:sr1, sc0:sc1]
+
+
+def _relay_tile(stage_arr, src_arr):
+    stage_arr[...] = src_arr
 
 
 def redistribute_taskpool(src: TiledMatrix, dst: TiledMatrix,
@@ -51,9 +63,9 @@ def redistribute_taskpool(src: TiledMatrix, dst: TiledMatrix,
         src.ln - disj_src, dst.ln - disj_dst)
     tp = DTDTaskpool(name=name)
 
-    def populate(taskpool: DTDTaskpool) -> None:
-        # for every target tile intersecting the copied region, insert one
-        # fragment-copy task per overlapping source tile
+    def _discover() -> list[tuple]:
+        """Every (dst tile, src tile, slice args) overlap fragment."""
+        out = []
         m0 = disi_dst // dst.mb
         m1 = (disi_dst + size_row - 1) // dst.mb
         n0 = disj_dst // dst.nb
@@ -68,7 +80,6 @@ def redistribute_taskpool(src: TiledMatrix, dst: TiledMatrix,
                                 disj_dst, disj_dst + size_col)
                 if d_r is None or d_c is None:
                     continue
-                dtile = taskpool.tile_of(dst, m, n)
                 # source tiles covering [d_r, d_c] shifted into src coords
                 s_r0, s_r1 = d_r[0] + shift_r, d_r[1] + shift_r
                 s_c0, s_c1 = d_c[0] + shift_c, d_c[1] + shift_c
@@ -84,7 +95,6 @@ def redistribute_taskpool(src: TiledMatrix, dst: TiledMatrix,
                                         s_c0, s_c1)
                         if o_r is None or o_c is None:
                             continue
-                        stile = taskpool.tile_of(src, sm, sn)
                         # slice indices local to each tile
                         args = (o_r[0] - shift_r - m * dst.mb,
                                 o_r[1] - shift_r - m * dst.mb,
@@ -94,10 +104,67 @@ def redistribute_taskpool(src: TiledMatrix, dst: TiledMatrix,
                                 o_r[1] - sm * src.mb,
                                 o_c[0] - sn * src.nb,
                                 o_c[1] - sn * src.nb)
-                        taskpool.insert_task(
-                            _copy_frag, (dtile, INOUT), (stile, INPUT),
-                            *[(a, VALUE) for a in args],
-                            name="copy_frag")
+                        out.append(((m, n), (sm, sn), args))
+        return out
+
+    def populate(taskpool: DTDTaskpool) -> None:
+        # for every target tile intersecting the copied region, insert one
+        # fragment-copy task per overlapping source tile (AFFINITY: the
+        # copy runs at the target tile's owner)
+        frags = _discover()
+        ctx = taskpool.context
+        nranks = ctx.nb_ranks if ctx is not None else 1
+        myrank = ctx.my_rank if ctx is not None else 0
+
+        # collective fan-out staging (comm/collectives.py): a source tile
+        # consumed by >= 2 remote ranks is relayed down the configured
+        # tree — the owner serves only its tree children, interior ranks
+        # re-serve their landed copy — instead of one pairwise pull per
+        # consumer rank (quadratic at production rank counts)
+        stage_src: dict[tuple, dict[int, object]] = {}
+        if nranks > 1 and _params.get("redist_collective_fanout"):
+            from ..comm.remote_dep import tree_parent
+            kind = _params.get("comm_bcast_tree")
+            consumers: dict[tuple, set[int]] = {}
+            for (m, n), skey, _a in frags:
+                consumers.setdefault(skey, set()).add(dst.rank_of(m, n))
+            stages = HashDataDist(
+                f"{name}_stage", nodes=nranks, myrank=myrank,
+                rank_fn=lambda sm, sn, r: r)
+            for skey in sorted(consumers):
+                owner = src.rank_of(*skey)
+                remote = sorted(consumers[skey] - {owner})
+                if len(remote) < 2:
+                    continue
+                order = [owner] + remote          # tree positions
+                shape = src.tile_shape(*skey)
+                stile = taskpool.tile_of(src, *skey)
+                tiles: dict[int, object] = {}
+                for pos in range(1, len(order)):
+                    key = skey + (order[pos],)
+                    stages.register(key,
+                                    np.zeros(shape, dtype=src.dtype))
+                    tiles[order[pos]] = taskpool.tile_of(stages, *key)
+                for pos in range(1, len(order)):
+                    parent = tree_parent(kind, pos, len(order))
+                    upstream = stile if parent == 0 \
+                        else tiles[order[parent]]
+                    taskpool.insert_task(
+                        _relay_tile,
+                        (tiles[order[pos]], INOUT | AFFINITY),
+                        (upstream, INPUT), name="relay_tile")
+                stage_src[skey] = tiles
+
+        for (m, n), skey, args in frags:
+            dtile = taskpool.tile_of(dst, m, n)
+            drank = dst.rank_of(m, n)
+            tiles = stage_src.get(skey)
+            read = tiles[drank] if tiles is not None and drank in tiles \
+                else taskpool.tile_of(src, *skey)
+            taskpool.insert_task(
+                _copy_frag, (dtile, INOUT | AFFINITY), (read, INPUT),
+                *[(a, VALUE) for a in args],
+                name="copy_frag")
         # the whole DAG is inserted here: release the insertion guard so the
         # taskpool can terminate without an explicit wait() (compose support)
         taskpool.close()

@@ -276,24 +276,38 @@ class ContinuousBatcher:
     def __init__(self, server: Any, model: ToyLM | None = None,
                  kv: PagedKVCollection | None = None,
                  max_batch: int | None = None,
-                 devices: str = "cpu") -> None:
+                 devices: str = "cpu",
+                 owner_rank: int | None = None) -> None:
         self._server = server
         self.model = model or ToyLM()
         H, D = self.model.num_heads, self.model.head_dim
+        # owner_rank pins EVERY collection tile to one rank of a
+        # multirank context: decode pools are submitted on this rank
+        # only (sharded serving, serve/sharded.py), so a default-owned
+        # (rank 0) tile on any other rank would shell the whole batch
+        # out to a rank that never enqueued the pool
+        self.owner_rank = owner_rank
+        _pin = None if owner_rank is None else (lambda *k: owner_rank)
+
+        def _dc(name: str, dtt: TileType) -> DictCollection:
+            return DictCollection(name, dtt=dtt, rank_of_fn=_pin)
+
         self.kv = kv or PagedKVCollection(
             "llmKV", page_size=_params.get("llm_page_size"),
             num_heads=H, head_dim=D,
-            max_pages=_params.get("llm_max_pages"))
+            max_pages=_params.get("llm_max_pages"),
+            rank_of_fn=None if owner_rank is None
+            else (lambda seq, page: owner_rank))
         assert (self.kv.num_heads, self.kv.head_dim) == (H, D), \
             "model and KV cache disagree on head geometry"
-        self.Q = DictCollection("llmQ", dtt=TileType((3, H, D), np.float32))
-        self.O = DictCollection("llmO", dtt=TileType((H, D), np.float32))
+        self.Q = _dc("llmQ", TileType((3, H, D), np.float32))
+        self.O = _dc("llmO", TileType((H, D), np.float32))
         # the in-graph SAMPLE class's side collections (ISSUE 9): TOK
         # carries the per-step [token, done, eos] chain tiles the host
         # reads once per superpool; EMB holds the precomputed q3 stack
         # table the SAMPLE kernel computes logits/next-queries from
         # (one gather per token — ToyLM.q3_table)
-        self.TOK = DictCollection("llmTOK", dtt=TileType((3,), np.float32))
+        self.TOK = _dc("llmTOK", TileType((3,), np.float32))
         # the batched speculative superpool's side collections (ISSUE
         # 12, llm/decode.spec_batched_ptg): QS the per-position query
         # stacks (position 0 the real current token, 1.. the drafter's
@@ -303,16 +317,12 @@ class ContinuousBatcher:
         # Tile shapes are per-pool (padded to llm_spec_k + 1); the
         # declared dtts only serve lazy zero-init before the first seed
         sp0 = max(1, int(_params.get("llm_spec_k"))) + 1
-        self.QS = DictCollection("llmQS",
-                                 dtt=TileType((sp0, 3, H, D), np.float32))
-        self.LIM = DictCollection("llmLIM",
-                                  dtt=TileType((sp0,), np.float32))
-        self.DTOKS = DictCollection("llmDTOKS",
-                                    dtt=TileType((sp0 + 2,), np.float32))
-        self.VOUT = DictCollection("llmVOUT",
-                                   dtt=TileType((sp0 + 2,), np.float32))
-        self.EMB = DictCollection(
-            "llmEMB", dtt=TileType(self.model.q3_table().shape, np.float32))
+        self.QS = _dc("llmQS", TileType((sp0, 3, H, D), np.float32))
+        self.LIM = _dc("llmLIM", TileType((sp0,), np.float32))
+        self.DTOKS = _dc("llmDTOKS", TileType((sp0 + 2,), np.float32))
+        self.VOUT = _dc("llmVOUT", TileType((sp0 + 2,), np.float32))
+        self.EMB = _dc(
+            "llmEMB", TileType(self.model.q3_table().shape, np.float32))
         seed_emb_table(self.model, self.EMB)
         self.max_batch = max_batch or _params.get("llm_max_batch")
         self.devices = devices
@@ -413,6 +423,23 @@ class ContinuousBatcher:
             self._pending.append(st)
         self._wake.set()
         return ticket
+
+    # -- placement hooks (serve/sharded.py) ------------------------------
+    def residency_len(self, prompt_tokens) -> int:
+        """How many leading TOKENS of a prospective prompt are already
+        resident in this batcher's prefix trie (full pages only) — the
+        KV-residency signal the sharded placement router maximizes.  0
+        with the prefix cache off."""
+        if self.prefix is None:
+            return 0
+        _seq, pages = self.prefix.match(list(prompt_tokens))
+        return pages * self.kv.page_size
+
+    def load(self) -> dict:
+        """Live + queued stream counts — the sharded router's
+        least-loaded fallback signal."""
+        with self._lock:
+            return {"live": len(self._live), "queued": len(self._pending)}
 
     def stats(self) -> dict:
         with self._lock:

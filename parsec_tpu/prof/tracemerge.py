@@ -27,6 +27,13 @@ Mechanics:
   ``flow_side`` (``emit``/``recv``) are matched by flow id; each matched
   pair gains an ``s`` event bound to the emitting span and an ``f``
   (``bp:"e"``) event bound to the receiving one.
+- **tree latency** — cross-rank ``act`` hops that share a ``trace`` id
+  (the collective-tree broadcast: root → interior → leaf staged
+  re-serve) are folded into per-trace tree stats: hop count, tree depth
+  (BFS from the rank that only emits), the rank set, and the critical
+  path — the slowest root-to-leaf chain of hop latencies — so a
+  broadcast's fan-out cost reads off the merge summary without opening
+  Perfetto.
 """
 
 from __future__ import annotations
@@ -54,6 +61,60 @@ def _load_events(path: str) -> list[dict]:
     if isinstance(trace, list):
         return trace
     return trace.get("traceEvents", [])
+
+
+def _tree_stats(flows: dict[str, dict[str, dict]]) -> dict[str, dict]:
+    """Per-trace tree latency over matched cross-rank ``act`` hops.
+
+    Each matched pair is one parent→child payload movement; grouping by
+    the spans' ``trace`` id recovers the propagation tree a collective
+    broadcast actually used.  Depth/critical-path walk the tree from its
+    roots (ranks that emit but never receive), summing per-hop latency
+    ``recv.ts - emit.ts`` — clocks are already on the shared wall axis.
+    """
+    by_trace: dict[str, list[tuple[int, int, float, float]]] = {}
+    for fl, sides in sorted(flows.items()):
+        if "emit" not in sides or "recv" not in sides:
+            continue
+        if fl.split(":", 1)[0] != "act":
+            continue
+        e, r = sides["emit"], sides["recv"]
+        src, dst = e["pid"] // 100, r["pid"] // 100
+        if src == dst:
+            continue
+        tr = ((e.get("args") or {}).get("trace")
+              or (r.get("args") or {}).get("trace"))
+        if not tr:
+            continue
+        by_trace.setdefault(tr, []).append((src, dst, e["ts"], r["ts"]))
+    trees: dict[str, dict] = {}
+    for tr, edges in sorted(by_trace.items()):
+        children: dict[int, list[tuple[int, float]]] = {}
+        dsts = set()
+        for src, dst, ets, rts in edges:
+            children.setdefault(src, []).append((dst, max(rts - ets, 0.0)))
+            dsts.add(dst)
+        roots = sorted({src for src, *_ in edges} - dsts)
+        if not roots:          # a cycle, not a tree — skip, don't loop
+            continue
+        depth = {r: 0 for r in roots}
+        lat = {r: 0.0 for r in roots}
+        frontier = list(roots)
+        while frontier:
+            src = frontier.pop()
+            for dst, hop_us in children.get(src, ()):
+                if dst in depth:          # duplicate delivery — keep first
+                    continue
+                depth[dst] = depth[src] + 1
+                lat[dst] = lat[src] + hop_us
+                frontier.append(dst)
+        trees[tr] = {
+            "hops": len(edges),
+            "depth": max(depth.values()),
+            "ranks": sorted(depth),
+            "critical_path_us": round(max(lat.values()), 3),
+        }
+    return trees
 
 
 def merge_traces(paths: list[str], out_path: str | None = None) -> dict:
@@ -96,7 +157,9 @@ def merge_traces(paths: list[str], out_path: str | None = None) -> dict:
         # exact end boundary falls outside the slice
         merged.append({"name": kind, "cat": "xtrace", "ph": "s",
                        "id": fid, "pid": e["pid"], "tid": e.get("tid", 0),
-                       "ts": e["ts"] + e.get("dur", 0) / 2})
+                       "ts": e["ts"] + e.get("dur", 0) / 2,
+                       "args": {"hop":
+                                f"{e['pid'] // 100}->{r['pid'] // 100}"}})
         merged.append({"name": kind, "cat": "xtrace", "ph": "f",
                        "bp": "e", "id": fid, "pid": r["pid"],
                        "tid": r.get("tid", 0),
@@ -106,7 +169,8 @@ def merge_traces(paths: list[str], out_path: str | None = None) -> dict:
         if e["pid"] // 100 != r["pid"] // 100:
             cross += 1
     stats = {"events": len(merged), "flows_matched": stitched,
-             "cross_rank_flows": cross, "flows_by_kind": by_kind}
+             "cross_rank_flows": cross, "flows_by_kind": by_kind,
+             "trees": _tree_stats(flows)}
     if out_path is not None:
         with open(out_path, "w") as f:
             json.dump({"traceEvents": merged}, f)
@@ -183,8 +247,55 @@ def self_test() -> int:
                         and e["args"]["flow_side"] == "recv")
         assert act_recv["ts"] > act_emit["ts"], (act_emit, act_recv)
         assert act_recv["pid"] // 100 == 1 and act_emit["pid"] // 100 == 0
+        # the single act hop is a degenerate tree: 1 hop, depth 1
+        # (latency tolerance: the wall axis sits at ~1.7e15 µs, so the
+        # float64 grid is ~0.25 µs there)
+        t1 = stats["trees"]["beef01"]
+        assert (t1["hops"], t1["depth"], t1["ranks"]) == \
+            (1, 1, [0, 1]), t1
+        assert abs(t1["critical_path_us"] - 8.0) < 1.0, t1
+
+    # --- the collective-tree case: a 4-rank binomial broadcast (edges
+    # 0->1, 0->2, 1->3) whose staged hops share one trace id.  Hop
+    # latencies 3/1/4 µs make 0->1->3 the critical path (7 µs), longer
+    # than the shallow 0->2 branch despite equal fan-out at the root. ---
+    def _tree_rank(rank, spans):
+        t = _synthetic_rank(rank, perf_base=1_000_000 * (rank + 1),
+                            unix_base=unix0, spans=spans)
+        for ev in t["traceEvents"]:
+            if ev.get("cat") == "span":
+                ev["args"]["trace"] = "beef02"
+        return t
+    tr = [
+        _tree_rank(0, [("comm.activate", 1000, 2000,
+                        {"flow": "act:0:1", "flow_side": "emit"}),
+                       ("comm.activate", 2000, 3000,
+                        {"flow": "act:0:2", "flow_side": "emit"})]),
+        _tree_rank(1, [("comm.activate", 4000, 5000,
+                        {"flow": "act:0:1", "flow_side": "recv"}),
+                       ("comm.activate", 5000, 6000,
+                        {"flow": "act:1:3", "flow_side": "emit"})]),
+        _tree_rank(2, [("comm.activate", 3000, 4000,
+                        {"flow": "act:0:2", "flow_side": "recv"})]),
+        _tree_rank(3, [("comm.activate", 9000, 10000,
+                        {"flow": "act:1:3", "flow_side": "recv"})]),
+    ]
+    with tempfile.TemporaryDirectory(prefix="tracemerge_") as d:
+        paths = []
+        for r, t in enumerate(tr):
+            p = os.path.join(d, f"trace-rank{r}.json")
+            with open(p, "w") as f:
+                json.dump(t, f)
+            paths.append(p)
+        stats = merge_traces(paths, os.path.join(d, "merged.json"))
+        assert stats["flows_matched"] == 3, stats
+        tree = stats["trees"]["beef02"]
+        assert tree["hops"] == 3, tree
+        assert tree["depth"] == 2, tree          # root -> 1 -> 3
+        assert tree["ranks"] == [0, 1, 2, 3], tree
+        assert abs(tree["critical_path_us"] - 7.0) < 1.0, tree
     print("tracemerge self-test: ok (2 flows stitched, 2 cross-rank, "
-          "clock-aligned)")
+          "clock-aligned; 4-rank tree: 3 hops, depth 2)")
     return 0
 
 
@@ -208,6 +319,10 @@ def main(argv: list[str] | None = None) -> int:
           f"{stats['flows_matched']} flows stitched "
           f"({stats['cross_rank_flows']} cross-rank, "
           f"by kind {stats['flows_by_kind']})")
+    for tr, t in stats["trees"].items():
+        print(f"  tree {tr}: {t['hops']} hops, depth {t['depth']}, "
+              f"ranks {t['ranks']}, critical path "
+              f"{t['critical_path_us']:.1f} us")
     return 0
 
 

@@ -6,7 +6,9 @@ from .admission import (AdmissionController, AdmissionRejected,
                         DeadlineExceeded, TicketCancelled)
 from .fair import FairScheduler
 from .server import RuntimeServer, Ticket
+from .sharded import ShardedRuntimeServer, ShardedStreamTicket
 
 __all__ = ["RuntimeServer", "Ticket", "FairScheduler",
            "AdmissionController", "AdmissionRejected", "DeadlineExceeded",
-           "TicketCancelled"]
+           "TicketCancelled", "ShardedRuntimeServer",
+           "ShardedStreamTicket"]

@@ -431,7 +431,12 @@ class RuntimeServer:
                     else "server context is poisoned")
             if self._llm is None:
                 from ..llm.batcher import ContinuousBatcher
-                self._llm = ContinuousBatcher(self)
+                # on a multirank context the batcher's collections pin
+                # to THIS rank: decode pools are enqueued here only, so
+                # default (rank 0) tile ownership would shell the work
+                # out to a rank that never sees the pool
+                own = self._ctx.my_rank if self._ctx.nb_ranks > 1 else None
+                self._llm = ContinuousBatcher(self, owner_rank=own)
             llm = self._llm
         return llm.submit_stream(prompt_tokens,
                                  max_new_tokens=max_new_tokens,

@@ -54,6 +54,12 @@ else
         -k "incarnations or rollback or acceptance_sweep or rejected" \
         -p no:cacheprovider
 
+    echo "== sharded serving plane (2-rank acceptance: token-for-token" \
+         "oracle-equal decode on both ranks + bucket-exact cross-rank" \
+         "SLO metrics merge) =="
+    python -m pytest tests/test_serve_sharded.py -q \
+        -k "oracle_equal_and_metrics_merge" -p no:cacheprovider
+
     echo "== llm microbench (smoke: tokens/s through the serving stack," \
          "swept over llm_steps_per_pool — superpool amortization) =="
     python -c 'import json, microbench; \

@@ -211,3 +211,71 @@ def test_socket_clean_path_has_no_replays():
     finally:
         f0.close()
         f1.close()
+
+
+# --------------------------------------------------------------------------
+# mid-tree rank death: resume a partially-landed GET from a new owner
+# --------------------------------------------------------------------------
+
+def test_mid_tree_death_resumes_from_surviving_owner():
+    """The collective-tree fault path (ISSUE 14): rank 2 pulls a staged
+    payload from its tree parent (rank 1); the parent dies with only part
+    of the window landed.  ``resume_get`` retargets the SAME landing zone
+    at a surviving holder (the grandparent, rank 0), which serves only
+    the missing offsets — and any zombie fragment the dead parent still
+    emitted dedups against the zone's landed-offset set exactly once."""
+    from parsec_tpu.comm.engine import AM_TAG_GET_FRAG
+
+    old_frag = params.get("comm_get_frag_bytes")
+    old_win = params.get("comm_get_window")
+    params.set("comm_get_frag_bytes", 64)
+    params.set("comm_get_window", 2)
+    try:
+        fabric = InprocFabric(3)
+        e0, e1, e2 = (fabric.attach(r) for r in range(3))
+        value = np.arange(64, dtype=np.float64)        # 512 B = 8 frags
+        h0 = e0.mem_register(value.copy(), refcount=1)
+        h1 = e1.mem_register(value.copy(), refcount=1)  # the staged copy
+
+        landed = []
+        gid = e2.get(h1.wire(), landed.append)
+        e1.progress()               # serve: first window (2 frags) out
+        e2.progress()               # land them; acks queue at rank 1
+        with e2._frag_lock:
+            zone = e2._landing[gid]
+            part = set(zone.landed)
+        assert len(part) == 2 and not landed
+
+        # rank 1 dies.  A zombie fragment it already emitted arrives late:
+        raw = value.view(np.uint8)
+        off = min(part)
+        fabric.deliver(2, AM_TAG_GET_FRAG, 1,
+                       (gid, off, 64, None, raw[off:off + 64].copy()))
+        e2.progress()
+        assert e2.dup_frags == 1 and not landed
+
+        # resume against the surviving owner BEFORE sweeping the dead
+        # peer (the zone retargets, so the sweep must not reap it)
+        assert e2.resume_get(h0.wire(), gid) is True
+        e2.on_peer_failed(1)
+        with e2._frag_lock:
+            assert gid in e2._landing       # retargeted, not reaped
+
+        for _ in range(16):
+            e0.progress()
+            e2.progress()
+            if landed:
+                break
+        assert len(landed) == 1
+        np.testing.assert_array_equal(landed[0], value)
+        # the new owner served ONLY the missing offsets (8 total - 2
+        # already landed), and the zone retired cleanly
+        assert e0.frags_out == 6
+        with e2._frag_lock:
+            assert gid not in e2._landing
+        assert e2._frag_active == 0
+        # nothing left to resume once the get completed
+        assert e2.resume_get(h0.wire(), gid) is False
+    finally:
+        params.set("comm_get_frag_bytes", old_frag)
+        params.set("comm_get_window", old_win)

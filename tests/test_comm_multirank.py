@@ -36,6 +36,46 @@ def test_tree_covers_every_node_once(kind, n):
     assert seen == set(range(n)), f"{kind} n={n}: missing {set(range(n)) - seen}"
 
 
+@pytest.mark.parametrize("kind", ["binomial", "chain", "star"])
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 13])
+def test_tree_parent_inverts_children(kind, n):
+    from parsec_tpu.comm.remote_dep import tree_parent
+    assert tree_parent(kind, 0, n) is None
+    for p in range(n):
+        for c in tree_children(kind, p, n):
+            assert tree_parent(kind, c, n) == p, (kind, n, p, c)
+    for c in range(1, n):
+        par = tree_parent(kind, c, n)
+        assert c in tree_children(kind, par, n), (kind, n, c, par)
+
+
+def test_unknown_tree_kind_raises_typed_mca_error():
+    """An unknown ``comm_bcast_tree`` value must raise the typed MCA
+    domain error naming the knob and its legal set — never silently
+    fall through to some default shape."""
+    from parsec_tpu.comm.remote_dep import TREE_KINDS, tree_parent
+    from parsec_tpu.core.params import MCAParamValueError
+    with pytest.raises(MCAParamValueError) as ei:
+        tree_children("fibonacci", 0, 8)
+    assert ei.value.param == "comm_bcast_tree"
+    assert ei.value.value == "fibonacci"
+    assert set(ei.value.allowed) == set(TREE_KINDS)
+    assert "comm_bcast_tree" in str(ei.value)
+    with pytest.raises(MCAParamValueError):
+        tree_parent("ring", 3, 8)
+    assert isinstance(ei.value, ValueError)   # catchable as plain ValueError
+
+
+@pytest.mark.parametrize("kind,n,expect", [
+    ("chain", 5, {0: [1], 1: [2], 2: [3], 3: [4], 4: []}),
+    ("star", 4, {0: [1, 2, 3], 1: [], 2: [], 3: []}),
+    ("binomial", 6, {0: [1, 2, 4], 1: [3, 5], 2: [], 3: [], 4: [], 5: []}),
+])
+def test_tree_shapes_exact(kind, n, expect):
+    got = {p: tree_children(kind, p, n) for p in range(n)}
+    assert got == expect
+
+
 # ---------------------------------------------------------------------------
 # PTG builders shared by the rank bodies
 # ---------------------------------------------------------------------------
