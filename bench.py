@@ -370,6 +370,59 @@ def _note_partial(**kw) -> None:
                 d["slo_hist"] = s
         except Exception:       # noqa: BLE001 — partials must never raise
             pass
+        try:
+            # the XLA-dispatch ledger rides every flush too: an rc-124
+            # death keeps the calls-per-DAG axis (ISSUE 16 satellite —
+            # the r06 campaign reads it off the partial)
+            from parsec_tpu.device.device import xla_calls_total
+            d["xla_calls_total"] = xla_calls_total()
+        except Exception:       # noqa: BLE001 — partials must never raise
+            pass
+
+
+_perfdb_state: dict = {"regressions": []}
+
+
+def _perfdb_note(name: str, result) -> None:
+    """Append this stage's scalars to the persistent perf ledger and
+    verdict each against its EWMA history (prof/perfdb.py): the
+    regression sentinel's bench hook.  Prints one per-stage verdict
+    line to stderr; regressions accumulate into ``_perfdb_state`` and
+    ride the emit as ``perfdb_regressions``.  Never raises, and MCA
+    ``perfdb=0`` disables it entirely."""
+    import sys
+    try:
+        from parsec_tpu.core.params import params
+        from parsec_tpu.prof.perfdb import PerfDB
+        if not params.get("perfdb"):
+            return
+        if isinstance(result, (int, float)) and not isinstance(result, bool):
+            result = {"value": float(result)}
+        if not isinstance(result, dict):
+            return
+        notes = PerfDB().note_result(f"bench.{name}", result)
+        if not notes:
+            return
+        reg = [n for n in notes if n["verdict"] == "regressed"]
+        imp = [n for n in notes if n["verdict"] == "improved"]
+        for n2 in reg:
+            _perfdb_state["regressions"].append(
+                {"stage": name, "metric": n2["metric"],
+                 "value": n2["value"], "z": n2.get("z"),
+                 "ewma": n2.get("ewma")})
+        if reg:
+            verdict = "REGRESSED " + ",".join(
+                f"{n['metric']} (z={n['z']})" for n in reg)
+        elif imp:
+            verdict = "improved " + ",".join(n["metric"] for n in imp)
+        elif all(n["verdict"] == "warming" for n in notes):
+            verdict = "warming"
+        else:
+            verdict = "ok"
+        print(f"[perfdb] {name}: {len(notes)} metric(s) -> {verdict}",
+              file=sys.stderr, flush=True)
+    except Exception:       # noqa: BLE001 — the ledger must never cost a run
+        pass
 
 
 def _time_lowered(low, sync_store: str, reps: int = 3):
@@ -1046,6 +1099,10 @@ def main() -> None:
                 "lowered_stencil_compile_s": res.get(
                     "lowered_stencil", {}).get("compile_s", 0.0),
                 "elapsed_s": round(time.perf_counter() - t_start, 1),
+                # the regression sentinel's verdicts (prof/perfdb.py):
+                # always present so the driver can key on it — empty
+                # list = no EWMA-flagged regressions this run
+                "perfdb_regressions": list(_perfdb_state["regressions"]),
                 "runtime_reports": reports,
                 **({"degraded_stages": degraded} if degraded else {}),
                 **({"abandoned_stages": list(_abandoned)}
@@ -1080,6 +1137,7 @@ def main() -> None:
                        else min(timeout, max(left, 15.0)))
             res[name] = _staged(name, fn, *a, timeout=timeout,
                                 retries=retries, **kw)
+        _perfdb_note(name, res[name])
         emit()
         return res[name]
 
@@ -1121,6 +1179,7 @@ def main() -> None:
     res["dispatch"] = d if isinstance(d, dict) else \
         {"dispatch_us": res["dispatch_us"]}
     res["dispatch"].setdefault("runtime_report", _runtime_report())
+    _perfdb_note("dispatch", res["dispatch"])
     emit()
     stage("gemm", bench_gemm_gflops, timeout=300.0, retries=2,
           primary=True, **cfg["gemm"])

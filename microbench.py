@@ -903,16 +903,29 @@ def bench_comm(smoke: bool = False) -> dict:
       framing (``comm_wire_binary=False``), the measured baseline the
       zero-copy path is judged against (ISSUE 4 acceptance: ≥3×);
     - ``comm_overlap_efficiency``     — fraction of a saturating 64MiB
-      fragmented GET's wall time the consumer spent retiring compute
-      (progress interleaved between compute units, the T3-style overlap).
+      fragmented GET's wall time the consumer spent inside compute units
+      (progress interleaved between compute units, the T3-style overlap);
+      ``comm_overlap_compute_frac`` is the companion calibrated-compute
+      fraction (units x solo unit cost / wall — lower under GIL/core
+      contention, the gap is contention overhead).
     """
     import numpy as np
 
     from parsec_tpu.comm.engine import AM_TAG_USER_BASE, InprocFabric
     from parsec_tpu.core.params import params
+    from parsec_tpu.prof import spans as _spans
 
     out: dict = {}
     reps = 3 if smoke else 5
+    # observe the whole stage with a PRIVATE span recorder (the
+    # bench_tracing save/restore idiom): every GET below records a
+    # comm.get span, so critpath can attribute the stage afterwards —
+    # the cross-check ISSUE 16's acceptance pins against the measured
+    # comm_overlap_efficiency
+    prev_rec = _spans.recorder
+    if prev_rec is not None:
+        _spans.uninstall()
+    rec = _spans.install()
     # smoke keeps the 4MiB point: it is the acceptance size the pickle
     # baseline is compared at, and the ratio there is wide enough
     # (~4x idle) to stay unambiguous under CI load
@@ -1000,17 +1013,34 @@ def bench_comm(smoke: bool = False) -> dict:
         h = e1.mem_register(big, refcount=1, owned=True)
         done: list = []
         units = [0]
+        # the overlap GET runs TRACED: its comm.get span plus an exec
+        # span per retired unit let critpath recompute the overlap
+        # efficiency from the span plane alone (agreement gate below)
+        tr = _spans.new_trace()
+        _now_ns = time.perf_counter_ns
+        busy_ns = 0
         t0 = time.perf_counter()
-        e0.get(h.wire(), done.append)
+        e0.get(h.wire(), done.append, trace=tr.trace_id)
         while not done:
+            u0 = _now_ns()
             unit()                      # compute retired mid-transfer
+            u1 = _now_ns()
+            rec.record("exec", tr.trace_id, u0, u1, None, "overlap_unit")
+            busy_ns += u1 - u0
             units[0] += 1
             e0.progress()
             e1.progress()
             if time.perf_counter() - t0 > 60.0:
                 raise TimeoutError("comm overlap GET did not complete")
         wall = time.perf_counter() - t0
+        # wall fraction spent inside compute units — the same quantity
+        # critpath recomputes from the span plane (|exec| within the GET
+        # window / |GET|), measured independently by inline accumulation
         out["comm_overlap_efficiency"] = round(
+            min(busy_ns / 1e9 / wall, 1.0), 3)
+        # calibrated-compute fraction: units retired x solo unit cost;
+        # trails the wall fraction by the GIL/core contention overhead
+        out["comm_overlap_compute_frac"] = round(
             min(units[0] * unit_s / wall, 1.0), 3)
         out["comm_overlap_units"] = units[0]
         e0.fini()
@@ -1048,9 +1078,32 @@ def bench_comm(smoke: bool = False) -> dict:
                 best = dt if best is None else min(best, dt)
             out[f"comm_get_inproc_{label}_gbps"] = round(
                 nbytes / best / 1e9, 3)
+
+        # -- critpath attribution over the stage's own spans ---------------
+        # the traced overlap request's span-derived efficiency must agree
+        # with the measured one (ISSUE 16 acceptance: within 15% rel);
+        # the untraced ladder GETs contribute the nonzero overlap_lost
+        # edge classes (no exec overlapped them by construction)
+        try:
+            from parsec_tpu.prof.critpath import attribute, normalize
+            t0 = time.perf_counter()
+            rep = attribute(normalize(list(rec.spans)))
+            out["comm_critpath_replay_s"] = round(
+                time.perf_counter() - t0, 4)
+            req = rep["requests"].get(format(tr.trace_id, "x"))
+            if req and req.get("overlap_efficiency") is not None:
+                out["comm_critpath_overlap_efficiency"] = round(
+                    req["overlap_efficiency"], 3)
+            out["comm_critpath_top_lost"] = rep["top_overlap_lost"]
+            out["comm_critpath_overlap_lost_ms"] = rep["overlap_lost_ms"]
+        except Exception as e:        # noqa: BLE001 — evidence over abort
+            out["comm_critpath_error"] = f"{type(e).__name__}: {e}"
     finally:
         for k, v in saved.items():
             params.set(k, v)
+        _spans.uninstall()
+        if prev_rec is not None:
+            _spans.install(recorder_obj=prev_rec)
     return out
 
 
@@ -1095,6 +1148,17 @@ def run_all(smoke: bool = False, include_lowering: bool = True,
             out.update(bench_lowering(smoke=smoke))
         except Exception as e:            # noqa: BLE001 — evidence over abort
             out["lowering_bench_error"] = f"{type(e).__name__}: {e}"
+    # persistent perf ledger (prof/perfdb.py): every scalar lands under
+    # the microbench.run_all workload so consecutive runs accrue EWMA
+    # history; MCA perfdb=0 disables, and a ledger failure never costs
+    # the run its numbers
+    try:
+        from parsec_tpu.core.params import params as _params
+        from parsec_tpu.prof.perfdb import PerfDB
+        if _params.get("perfdb"):
+            PerfDB().note_result("microbench.run_all", out)
+    except Exception:       # noqa: BLE001 — evidence over abort
+        pass
     return out
 
 

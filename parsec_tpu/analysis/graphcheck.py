@@ -180,6 +180,16 @@ class GraphReport:
         from .regions import regions_of_report
         return regions_of_report(self, max_tasks=max_tasks)
 
+    def critical_path(self, class_costs: dict | None = None) -> dict:
+        """Longest-cost chain over the verified concrete graph
+        (:func:`parsec_tpu.prof.critpath.dag_critical_path`), each node
+        weighted by its class's measured mean exec cost — pass
+        ``class_costs`` from a critpath report
+        (``critpath.class_costs_from``) to turn the structural DAG into
+        a TIME-weighted critical path; unit weights otherwise."""
+        from ..prof.critpath import dag_critical_path
+        return dag_critical_path(self.graph, class_costs)
+
     def summary(self) -> str:
         state = "OK" if self.ok else "FAILED"
         return (f"graphcheck {self.name}: {state} — {self.ntasks} tasks, "

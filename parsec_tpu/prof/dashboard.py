@@ -26,12 +26,34 @@ from typing import Any
 from .counters import read_live_snapshot
 
 
+def render_critpath(report: dict) -> str:
+    """The critical-path panel (pure; testable): bucket bar + top
+    overlap_lost edge classes from a :mod:`critpath` compact report."""
+    lines = ["critical path"]
+    bk = report.get("buckets_ms") or {}
+    tot = sum(bk.values()) or 1.0
+    order = ("exec", "release", "queue", "comm.activate", "comm.get",
+             "idle")
+    parts = [f"{b} {bk[b]:.1f}ms ({100 * bk[b] / tot:.0f}%)"
+             for b in order if bk.get(b, 0) > 0]
+    lines.append("  " + (" | ".join(parts) if parts else "(no spans)"))
+    eff = report.get("overlap_efficiency")
+    if eff is not None:
+        lines.append(f"  overlap eff {eff:.3f}   "
+                     f"lost {report.get('overlap_lost_ms', 0):.2f}ms")
+    for cls, ms in report.get("top_overlap_lost") or []:
+        lines.append(f"  lost {cls:<28} {ms:9.3f}ms")
+    return "\n".join(lines)
+
+
 def render_snapshot(snap: dict) -> str:
     """One snapshot -> a fixed-width table (pure; testable)."""
     props: dict[str, dict[str, Any]] = snap.get("props", {})
     ts = snap.get("ts", 0.0)
     lines = [f"parsec-tpu live properties   "
              f"@ {time.strftime('%H:%M:%S', time.localtime(ts))}"]
+    if snap.get("critpath"):
+        lines.append(render_critpath(snap["critpath"]))
     namespaces = sorted(props)
     # collect the union of scalar gauge names; dict-valued gauges (sde)
     # expand into their own rows
@@ -85,6 +107,13 @@ def main(argv: list[str] | None = None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     if not args or args[0] in ("-h", "--help"):
         print(__doc__)
+        return 0
+    if args[0] == "--critpath":
+        # one-shot offline panel over a trace artifact (chrome or raw
+        # spans export) — the same renderer the live loop embeds
+        from .critpath import attribute, load
+        rep = attribute(load(args[1]))
+        print(render_critpath(rep))
         return 0
     interval = float(args[1]) if len(args) > 1 else 0.5
     try:

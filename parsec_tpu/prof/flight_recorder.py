@@ -469,6 +469,20 @@ def runtime_report(max_workers: int = 6) -> dict:
                            "h2d_bytes": 0, "comm_activations_sent": 0,
                            "snapshots": len(snapshotter.series),
                            "workers": {}}
+    # critical-path attribution over the span plane (prof/critpath.py):
+    # present only when the span recorder is installed AND recorded —
+    # every other run stays byte-compatible and pays nothing (the
+    # attribution replays existing spans, no new hot-path sites).  The
+    # span plane is independent of the flight recorder, so this block
+    # precedes the flightrec-disabled early return.
+    from . import spans as _spans_mod
+    if _spans_mod.recorder is not None and _spans_mod.recorder.spans:
+        def _critpath():
+            from .critpath import summarize_recorder
+            return summarize_recorder(compact=True)
+        cp = _best_effort(_critpath, default={})
+        if cp:
+            rep["critpath"] = cp
     r = recorder
     if r is None:
         rep["flightrec"] = "disabled"

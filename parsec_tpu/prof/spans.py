@@ -302,6 +302,19 @@ def to_chrome_events(pid: int = 3) -> list[dict]:
     return meta + events
 
 
+def export_spans(path: str, rank: int = 0) -> dict:
+    """Write THIS rank's spans RAW (the recorder tuples, json-listed) —
+    the lossless input :mod:`critpath` replays; Chrome export rounds
+    sub-µs spans up, this keeps the ns clocks."""
+    r = recorder
+    doc = {"rank": rank,
+           "spans": [list(s) for s in (list(r.spans) if r else [])],
+           "dropped": r.dropped if r else 0}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return {"path": path, "spans": len(doc["spans"]), "rank": rank}
+
+
 def export_chrome(path: str, rank: int = 0) -> dict:
     """Write THIS rank's spans as a standalone Chrome trace, anchored by
     a wall-clock sync event — ``perf_counter_ns`` clocks are per-process,

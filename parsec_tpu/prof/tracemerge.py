@@ -74,11 +74,11 @@ def _tree_stats(flows: dict[str, dict[str, dict]]) -> dict[str, dict]:
     """
     by_trace: dict[str, list[tuple[int, int, float, float]]] = {}
     for fl, sides in sorted(flows.items()):
-        if "emit" not in sides or "recv" not in sides:
+        if not sides.get("emit") or not sides.get("recv"):
             continue
         if fl.split(":", 1)[0] != "act":
             continue
-        e, r = sides["emit"], sides["recv"]
+        e, r = _endpoints(sides)
         src, dst = e["pid"] // 100, r["pid"] // 100
         if src == dst:
             continue
@@ -117,12 +117,31 @@ def _tree_stats(flows: dict[str, dict[str, dict]]) -> dict[str, dict]:
     return trees
 
 
+def _endpoints(sides: dict[str, list[dict]]) -> tuple[dict, dict]:
+    """The hop endpoints for one flow key: the LAST emit (by aligned
+    timestamp) to the FIRST recv.  A GET resumed via ``resume_get``
+    re-serves under the SAME ``get:<requester>:<get_id>`` key from a
+    NEW rank — the survivor's emit is the one whose bytes actually
+    landed, so the arrow binds there (matching on (key, src rank)
+    would lose it)."""
+    emits = sorted(sides["emit"], key=lambda ev: ev["ts"])
+    recvs = sorted(sides["recv"], key=lambda ev: ev["ts"])
+    return emits[-1], recvs[0]
+
+
+def _is_resumed(sides: dict[str, list[dict]]) -> bool:
+    return (len(sides["emit"]) > 1
+            or len({ev["pid"] // 100 for ev in sides["emit"]}) > 1)
+
+
 def merge_traces(paths: list[str], out_path: str | None = None) -> dict:
     """Merge per-rank traces; returns stats (and writes the merged trace
     when ``out_path`` is given)."""
     merged: list[dict] = []
-    # flow id -> side -> first event seen (the hop endpoints)
-    flows: dict[str, dict[str, dict]] = {}
+    # flow id -> side -> ALL events seen (a resumed GET re-serves under
+    # the same key from a new rank — every emit must be kept so the
+    # arrow can bind to the survivor)
+    flows: dict[str, dict[str, list[dict]]] = {}
     for pos, path in enumerate(paths):
         rank = _rank_of(path, pos)
         events = _load_events(path)
@@ -143,23 +162,28 @@ def merge_traces(paths: list[str], out_path: str | None = None) -> dict:
             a = ev.get("args") or {}
             fl, side = a.get("flow"), a.get("flow_side")
             if fl and side in ("emit", "recv"):
-                flows.setdefault(fl, {}).setdefault(side, ev)
-    stitched = cross = 0
+                flows.setdefault(fl, {}).setdefault(side, []).append(ev)
+    stitched = cross = resumed_n = 0
     by_kind: dict[str, int] = {}
     for fl, sides in sorted(flows.items()):
-        if "emit" not in sides or "recv" not in sides:
+        if not sides.get("emit") or not sides.get("recv"):
             continue
-        e, r = sides["emit"], sides["recv"]
+        e, r = _endpoints(sides)
+        resumed = _is_resumed(sides)
         fid = zlib.crc32(fl.encode())
         kind = fl.split(":", 1)[0]
+        s_args: dict[str, Any] = {
+            "hop": f"{e['pid'] // 100}->{r['pid'] // 100}"}
+        if resumed:
+            s_args["resumed"] = 1
+            resumed_n += 1
         # bind arrows to the MIDDLE of each span: s/f events attach to
         # the slice enclosing their timestamp on that pid/tid, and the
         # exact end boundary falls outside the slice
         merged.append({"name": kind, "cat": "xtrace", "ph": "s",
                        "id": fid, "pid": e["pid"], "tid": e.get("tid", 0),
                        "ts": e["ts"] + e.get("dur", 0) / 2,
-                       "args": {"hop":
-                                f"{e['pid'] // 100}->{r['pid'] // 100}"}})
+                       "args": s_args})
         merged.append({"name": kind, "cat": "xtrace", "ph": "f",
                        "bp": "e", "id": fid, "pid": r["pid"],
                        "tid": r.get("tid", 0),
@@ -169,8 +193,21 @@ def merge_traces(paths: list[str], out_path: str | None = None) -> dict:
         if e["pid"] // 100 != r["pid"] // 100:
             cross += 1
     stats = {"events": len(merged), "flows_matched": stitched,
-             "cross_rank_flows": cross, "flows_by_kind": by_kind,
+             "cross_rank_flows": cross, "resumed_flows": resumed_n,
+             "flows_by_kind": by_kind,
              "trees": _tree_stats(flows)}
+    # critical-path attribution over the STITCHED trace: the per-rank
+    # clocks are already on the shared wall axis here, so the compact
+    # report spans rank boundaries (the tree-stats fold's sibling)
+    try:
+        from .critpath import attribute, from_chrome
+        rep = attribute(from_chrome(merged))
+        stats["critpath"] = {k: rep[k] for k in
+                             ("spans", "traces", "buckets_ms",
+                              "overlap_efficiency", "overlap_lost_ms",
+                              "top_overlap_lost")}
+    except Exception as exc:                 # noqa: BLE001 — best-effort
+        stats["critpath"] = {"error": f"{type(exc).__name__}: {exc}"}
     if out_path is not None:
         with open(out_path, "w") as f:
             json.dump({"traceEvents": merged}, f)
@@ -230,7 +267,15 @@ def self_test() -> int:
         stats = merge_traces([p0, p1], out)
         assert stats["flows_matched"] == 2, stats
         assert stats["cross_rank_flows"] == 2, stats
+        assert stats["resumed_flows"] == 0, stats
         assert stats["flows_by_kind"] == {"act": 1, "get": 1}, stats
+        # the stitched trace feeds critpath cross-rank: both comm spans
+        # attributed, the 6 µs GET fully unhidden (no exec anywhere)
+        cp = stats["critpath"]
+        assert cp["spans"] == 4, cp
+        assert cp["buckets_ms"]["comm.get"] > 0, cp
+        assert cp["top_overlap_lost"] and \
+            cp["top_overlap_lost"][0][0].startswith("comm.get"), cp
         with open(out) as f:
             evs = json.load(f)["traceEvents"]
         s = [e for e in evs if e.get("ph") == "s"]
@@ -294,8 +339,47 @@ def self_test() -> int:
         assert tree["depth"] == 2, tree          # root -> 1 -> 3
         assert tree["ranks"] == [0, 1, 2, 3], tree
         assert abs(tree["critical_path_us"] - 7.0) < 1.0, tree
+
+    # --- the resumed-GET case (ISSUE 16 satellite): rank 0 starts
+    # serving get:1:9, dies mid-flight; resume_get retargets the landing
+    # zone at rank 2, which re-serves under the SAME flow key; rank 1's
+    # recv completes against the survivor.  The arrow must bind rank 2's
+    # emit (matching on (key, src rank) would keep only rank 0's dead
+    # partial) and carry resumed=1. ---
+    r0 = _synthetic_rank(0, perf_base=1_000_000, unix_base=unix0, spans=[
+        ("comm.get_serve", 1000, 3000,
+         {"flow": "get:1:9", "flow_side": "emit", "partial": 1}),
+    ])
+    r1 = _synthetic_rank(1, perf_base=2_000_000, unix_base=unix0, spans=[
+        ("comm.get", 1000, 9000,
+         {"flow": "get:1:9", "flow_side": "recv"}),
+    ])
+    r2 = _synthetic_rank(2, perf_base=3_000_000, unix_base=unix0, spans=[
+        ("comm.get_serve", 5000, 8000,
+         {"flow": "get:1:9", "flow_side": "emit"}),
+    ])
+    with tempfile.TemporaryDirectory(prefix="tracemerge_") as d:
+        paths = []
+        for r, t in enumerate((r0, r1, r2)):
+            p = os.path.join(d, f"trace-rank{r}.json")
+            with open(p, "w") as f:
+                json.dump(t, f)
+            paths.append(p)
+        out = os.path.join(d, "merged.json")
+        stats = merge_traces(paths, out)
+        assert stats["flows_matched"] == 1, stats
+        assert stats["resumed_flows"] == 1, stats
+        with open(out) as f:
+            evs = json.load(f)["traceEvents"]
+        s = [e for e in evs if e.get("ph") == "s"]
+        assert len(s) == 1, s
+        # the arrow leaves the SURVIVOR's emit (rank 2), tagged resumed
+        assert s[0]["pid"] // 100 == 2, s
+        assert s[0]["args"].get("resumed") == 1, s
+        assert s[0]["args"]["hop"] == "2->1", s
     print("tracemerge self-test: ok (2 flows stitched, 2 cross-rank, "
-          "clock-aligned; 4-rank tree: 3 hops, depth 2)")
+          "clock-aligned; 4-rank tree: 3 hops, depth 2; resumed GET "
+          "rebinds to the survivor emit)")
     return 0
 
 
@@ -318,7 +402,17 @@ def main(argv: list[str] | None = None) -> int:
     print(f"{out}: {stats['events']} events, "
           f"{stats['flows_matched']} flows stitched "
           f"({stats['cross_rank_flows']} cross-rank, "
+          f"{stats['resumed_flows']} resumed, "
           f"by kind {stats['flows_by_kind']})")
+    cp = stats.get("critpath") or {}
+    if cp.get("buckets_ms"):
+        bk = cp["buckets_ms"]
+        eff = cp.get("overlap_efficiency")
+        print("  critpath: " + " | ".join(
+            f"{b} {v:.2f}ms" for b, v in bk.items() if v > 0)
+            + (f"  (overlap eff {eff:.3f}, lost "
+               f"{cp['overlap_lost_ms']:.2f}ms)" if eff is not None
+               else ""))
     for tr, t in stats["trees"].items():
         print(f"  tree {tr}: {t['hops']} hops, depth {t['depth']}, "
               f"ranks {t['ranks']}, critical path "

@@ -600,12 +600,25 @@ class RuntimeServer:
         record at worst misses the snapshot by one sample)."""
         with self._lock:
             inflight = len(self._inflight)
-        return {
+        out = {
             "tenants": self._slo.summary(),
             "inflight": inflight,
             "drain_s": self._drain_s,
             "admission": self._adm.stats(),
         }
+        # critical-path attribution over the span plane — present only
+        # when the recorder is installed (a drained server's post-mortem
+        # reads where its requests' latency went without re-running)
+        try:
+            from ..prof import spans as _spans
+            if _spans.recorder is not None and _spans.recorder.spans:
+                from ..prof.critpath import summarize_recorder
+                cp = summarize_recorder(compact=True)
+                if cp:
+                    out["critpath"] = cp
+        except Exception:        # noqa: BLE001 — metrics never raise
+            pass
+        return out
 
     def _stall_section(self) -> dict:
         """Per-tenant inflight counts + the oldest live request's trace

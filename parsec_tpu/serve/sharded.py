@@ -49,8 +49,11 @@ from ..comm.engine import AM_TAG_USER_BASE
 from ..comm.remote_dep import tree_children
 from ..core.future import Future
 from ..core.params import params as _params
+from ..prof import spans as _spans
 from ..prof.histogram import LogHistogram, _summarize
 from .server import RuntimeServer
+
+_now = time.perf_counter_ns
 
 AM_TAG_SERVE = AM_TAG_USER_BASE + 8      # the sharded-serve control tag
 
@@ -73,6 +76,7 @@ class ShardedStreamTicket:
         self.max_new = max_new
         self.eos = eos
         self.priority = 0
+        self.trace = 0                   # span trace id (0 = untraced)
         self.rank: int = -1              # current placement
         self.ranks: list[int] = []       # every rank that served a slice
         self.tokens: list[int] = []
@@ -123,15 +127,16 @@ class _Local:
     """A stream this rank is decoding: the underlying local ticket plus
     the shipping cursor (how many tokens the frontend has seen)."""
 
-    __slots__ = ("sid", "ticket", "base", "shipped", "reply_to")
+    __slots__ = ("sid", "ticket", "base", "shipped", "reply_to", "trace")
 
     def __init__(self, sid: int, ticket: Any, base: int,
-                 reply_to: int) -> None:
+                 reply_to: int, trace: int = 0) -> None:
         self.sid = sid
         self.ticket = ticket
         self.base = base                 # stream index of local token 0
         self.shipped = 0                 # local tokens already shipped
         self.reply_to = reply_to
+        self.trace = trace               # the stream's span trace id
 
 
 class ShardedRuntimeServer:
@@ -178,11 +183,11 @@ class ShardedRuntimeServer:
         # act from step()/serve_step() on the caller's thread
         self._inbox.append((src, payload))
 
-    def _send(self, dst: int, msg: dict) -> None:
+    def _send(self, dst: int, msg: dict, trace: int = 0) -> None:
         if dst == self.rank:
             self._inbox.append((self.rank, msg))
         elif self._ce is not None:
-            self._ce.send_am(AM_TAG_SERVE, dst, msg)
+            self._ce.send_am(AM_TAG_SERVE, dst, msg, trace_id=trace)
 
     # -- placement (frontend) -------------------------------------------
     def _residency(self, rank: int, prompt: list[int]) -> int:
@@ -224,6 +229,11 @@ class ShardedRuntimeServer:
         self._next_sid += 1
         h = ShardedStreamTicket(sid, tenant, prompt, max_new_tokens, eos)
         h.priority = priority
+        # one-branch disabled cost: a trace is minted only when the span
+        # recorder is installed, and rides every control-plane frame so
+        # critpath can attribute the SUBMIT/TOKENS hops to this stream
+        if _spans.recorder is not None:
+            h.trace = _spans.new_trace().trace_id
         self._handles[sid] = h
         rank = self._place(prompt)
         self._dispatch(h, rank, prompt, max_new_tokens, base=0)
@@ -235,10 +245,20 @@ class ShardedRuntimeServer:
         h.ranks.append(rank)
         self._rank_load[rank] += 1
         self._router_hist.setdefault(rank, []).append(list(prompt))
-        self._send(rank, {"op": "SUBMIT", "sid": h.sid, "prompt": prompt,
-                          "max_new": max_new, "tenant": h.tenant,
-                          "priority": h.priority, "eos": h.eos,
-                          "base": base, "reply_to": self.rank})
+        seq = len(h.ranks)               # distinguishes requeue re-submits
+        msg = {"op": "SUBMIT", "sid": h.sid, "prompt": prompt,
+               "max_new": max_new, "tenant": h.tenant,
+               "priority": h.priority, "eos": h.eos,
+               "base": base, "reply_to": self.rank,
+               "trace": h.trace, "seq": seq}
+        r = _spans.recorder
+        if r is not None and rank != self.rank:
+            t0 = _now()
+            self._send(rank, msg, trace=h.trace)
+            r.record("serve.submit", h.trace, t0, _now(), h.tenant,
+                     {"flow": f"ssub:{h.sid}:{seq}", "flow_side": "emit"})
+        else:
+            self._send(rank, msg, trace=h.trace)
 
     # -- config broadcast (collective tree) ------------------------------
     def broadcast_config(self, *, weights: dict[str, float] | None = None,
@@ -285,22 +305,40 @@ class ShardedRuntimeServer:
 
     def _handle(self, src: int, msg: dict) -> None:
         op = msg["op"]
+        r = _spans.recorder
         if op == "SUBMIT":
+            t0 = _now() if r is not None else 0
             t = self._local.submit_stream(
                 msg["prompt"], max_new_tokens=msg["max_new"],
                 tenant=msg["tenant"], priority=msg.get("priority", 0),
                 eos=msg["eos"])
             with self._lock:
                 self._live[msg["sid"]] = _Local(
-                    msg["sid"], t, msg["base"], msg["reply_to"])
+                    msg["sid"], t, msg["base"], msg["reply_to"],
+                    trace=msg.get("trace", 0))
+            if r is not None and src != self.rank:
+                r.record("serve.submit", msg.get("trace", 0), t0, _now(),
+                         msg.get("tenant"),
+                         {"flow": f"ssub:{msg['sid']}:{msg.get('seq', 0)}",
+                          "flow_side": "recv"})
         elif op == "TOKENS":
             h = self._handles.get(msg["sid"])
             if h is not None:
                 # a settled handle still LANDS the delta: the dedup
                 # ledger must see (and count) a zombie rank's replays
+                t0 = _now() if r is not None else 0
                 h._land(msg["base"], msg["toks"])
+                if r is not None and src != self.rank:
+                    r.record("serve.tokens", h.trace, t0, _now(), h.tenant,
+                             {"flow": f"stok:{msg['sid']}:{msg['base']}",
+                              "flow_side": "recv"})
         elif op == "DONE":
             h = self._handles.get(msg["sid"])
+            if h is not None and r is not None and src != self.rank:
+                t0 = _now()
+                r.record("serve.tokens", h.trace, t0, _now(), h.tenant,
+                         {"flow": f"stok:{msg['sid']}:d{msg['base']}",
+                          "flow_side": "recv"})
             if h is not None and not h.done():
                 if msg["sid"] in self._handles:
                     self._rank_load[h.rank] = \
@@ -335,10 +373,17 @@ class ShardedRuntimeServer:
             if len(toks) > e.shipped:
                 delta = toks[e.shipped:]
                 if e.reply_to != self.rank:
+                    base = e.base + e.shipped
+                    r = _spans.recorder
+                    t0 = _now() if r is not None else 0
                     self._send(e.reply_to,
                                {"op": "TOKENS", "sid": e.sid,
-                                "base": e.base + e.shipped,
-                                "toks": delta})
+                                "base": base, "toks": delta},
+                               trace=e.trace)
+                    if r is not None:
+                        r.record("serve.tokens", e.trace, t0, _now(), None,
+                                 {"flow": f"stok:{e.sid}:{base}",
+                                  "flow_side": "emit"})
                 else:
                     h = self._handles.get(e.sid)
                     if h is not None:
@@ -354,10 +399,17 @@ class ShardedRuntimeServer:
                 except BaseException as exc:   # ship the failure, not hang
                     err = f"{type(exc).__name__}: {exc}"
                 if e.reply_to != self.rank:
+                    base = e.base + e.shipped
+                    r = _spans.recorder
+                    t0 = _now() if r is not None else 0
                     self._send(e.reply_to,
                                {"op": "DONE", "sid": e.sid,
-                                "base": e.base + e.shipped, "toks": [],
-                                "error": err})
+                                "base": base, "toks": [],
+                                "error": err}, trace=e.trace)
+                    if r is not None:
+                        r.record("serve.tokens", e.trace, t0, _now(), None,
+                                 {"flow": f"stok:{e.sid}:d{base}",
+                                  "flow_side": "emit"})
                 else:
                     h = self._handles.get(e.sid)
                     if h is not None:

@@ -33,6 +33,16 @@ else
     echo "== tracemerge (cross-rank trace stitching self-test) =="
     python -m parsec_tpu.prof.tracemerge --self-test
 
+    echo "== critpath (critical-path attribution self-test: additive" \
+         "sweep, overlap_lost, chrome round-trip, DAG, cycle-safety) =="
+    python -m parsec_tpu.prof.critpath --self-test
+
+    echo "== perfdb (perf ledger + regression sentinel: EWMA verdicts," \
+         "note_result walk, backfill ingest) =="
+    python -m parsec_tpu.prof.perfdb --self-test
+    python -m pytest tests/test_critpath.py tests/test_perf_smoke.py -q \
+        -k "perfdb or critpath" -p no:cacheprovider
+
     echo "== tracing overhead gate (disabled span path within 10% of" \
          "the overhead baseline; allocation-free; enabled <=1us budget" \
          "at headroom) =="

@@ -32,8 +32,10 @@ def smoke_run(tmp_path_factory):
     """One full BENCH_SMOKE=1 run on CPU, shared by the assertions."""
     env = dict(os.environ)
     env.update(BENCH_SMOKE="1", BENCH_PLATFORM="cpu")
-    # run from a scratch cwd so BENCH_partial.json lands there
+    # run from a scratch cwd so BENCH_partial.json lands there — and
+    # point the artifact dir at it so perfdb.jsonl (ISSUE 16) does too
     cwd = tmp_path_factory.mktemp("bench")
+    env["PARSEC_TPU_ARTIFACT_DIR"] = str(cwd)
     t0 = time.perf_counter()
     p = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
                        capture_output=True, text=True, env=env,
@@ -265,6 +267,78 @@ def test_partial_file_mirrors_last_line(smoke_run):
     # elapsed_s differs line to line; compare the stable payload
     mirrored["extra"].pop("elapsed_s"), last["extra"].pop("elapsed_s")
     assert mirrored == last
+
+
+def test_perfdb_ledger_written_and_verdicts_in_emit(smoke_run):
+    """ISSUE-16: a bench run appends every stage's scalars to the
+    persistent perf ledger, prints one [perfdb] verdict line per stage,
+    and the emit carries the ``perfdb_regressions`` export on EVERY
+    cumulative line (any line may be the last one the driver sees)."""
+    p, _dt, cwd = smoke_run
+    ledger = os.path.join(str(cwd), "perfdb.jsonl")
+    assert os.path.exists(ledger), os.listdir(str(cwd))
+    recs = [json.loads(ln) for ln in open(ledger) if ln.strip()]
+    assert len(recs) > 50, len(recs)        # dozens of metrics x stages
+    assert all("key" in r and "value" in r for r in recs)
+    assert "[perfdb]" in p.stderr
+    for ln in _json_lines(p.stdout):
+        assert isinstance(ln["extra"].get("perfdb_regressions"), list), ln
+
+
+def test_perfdb_accrues_across_invocations_and_verdicts_drift(
+        tmp_path, monkeypatch, capsys):
+    """ISSUE-16 acceptance, harness form: consecutive invocations of the
+    bench perfdb hook accrue history in one ledger file, and once the
+    EWMA is warm a 10x cliff in a later invocation is verdicted
+    REGRESSED — in the stderr line AND in the ``perfdb_regressions``
+    export the next emit would carry."""
+    import bench
+    monkeypatch.setenv("PARSEC_TPU_ARTIFACT_DIR", str(tmp_path))
+    ledger = tmp_path / "perfdb.jsonl"
+    prior = dict(bench._perfdb_state)
+    try:
+        bench._perfdb_state["regressions"] = []
+        # invocations 1..3: stable numbers warm the per-key EWMA
+        for _ in range(3):
+            bench._perfdb_note("fakestage", {"dispatch_us": 100.0})
+        n1 = sum(1 for _ in open(ledger))
+        assert n1 == 3
+        assert bench._perfdb_state["regressions"] == []
+        # invocation 4: the 10x cliff
+        bench._perfdb_note("fakestage", {"dispatch_us": 1000.0})
+        assert sum(1 for _ in open(ledger)) == n1 + 1   # still accruing
+        reg = bench._perfdb_state["regressions"]
+        assert len(reg) == 1, reg
+        assert reg[0]["stage"] == "fakestage"
+        assert reg[0]["metric"] == "dispatch_us" and reg[0]["z"] > 0
+        err = capsys.readouterr().err
+        assert "[perfdb] fakestage" in err and "REGRESSED" in err, err
+    finally:
+        bench._perfdb_state.clear()
+        bench._perfdb_state.update(prior)
+
+
+def test_deadline_death_flushes_xla_dispatch_ledger():
+    """ISSUE-16 satellite: an rc-124-shaped stage death must keep the
+    calls-per-DAG axis — every ``_note_partial`` flush snapshots the
+    XLA-dispatch ledger total alongside the histogram planes."""
+    import bench
+    from parsec_tpu.device.device import note_xla_calls, xla_calls_total
+
+    base = xla_calls_total()
+    note_xla_calls(7)                      # the stage dispatched work
+
+    def fake_xla_stage():
+        bench._note_partial(phase="compile", lowering_mode="region")
+        time.sleep(30)
+
+    prior = list(bench._abandoned)
+    try:
+        res = bench._staged("fakexla", fake_xla_stage, timeout=0.3)
+        assert res["status"] == "compile_timeout", res
+        assert res["partial"]["xla_calls_total"] >= base + 7, res
+    finally:
+        bench._abandoned[:] = prior
 
 
 def test_hung_stage_is_abandoned_not_fatal():

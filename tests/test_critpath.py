@@ -1,0 +1,172 @@
+"""Critical-path attribution engine (ISSUE 16): the replay that turns
+the span plane into *where did this request's wall-clock go*.
+
+- the packaged self-test (additive sweep, overlap_lost, chrome
+  round-trip, DAG critical path, cycle safety) run as a unit test;
+- the accounting identity pinned independently on fresh synthetic
+  spans (sum(buckets) + idle == window, exactly);
+- the CLI contract (`python -m parsec_tpu.prof.critpath trace.json
+  --json`) against a file on disk;
+- the ISSUE-16 satellite: a 2-rank ShardedRuntimeServer stream run
+  TRACED — critpath must attribute the SUBMIT/TOKENS control-plane
+  hops as `serve.submit` / `serve.tokens` edge classes on the
+  stream's own trace id.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+
+from parsec_tpu.prof import spans
+from parsec_tpu.prof.critpath import (attribute, dag_critical_path,
+                                      from_chrome, normalize,
+                                      summarize_recorder)
+
+MS = 1_000_000
+
+
+def test_packaged_self_test():
+    from parsec_tpu.prof import critpath
+    assert critpath.self_test() == 0
+
+
+def test_perfdb_packaged_self_test(tmp_path, monkeypatch):
+    monkeypatch.setenv("PARSEC_TPU_ARTIFACT_DIR", str(tmp_path))
+    from parsec_tpu.prof import perfdb
+    assert perfdb.self_test() == 0
+
+
+def test_decomposition_is_an_accounting_identity():
+    """Overlapping spans never double-count: each elementary segment is
+    charged to exactly one bucket, so the sum reconstructs the window."""
+    sp = normalize([
+        ("queue_wait", 0x7, 0, 3 * MS, None, None, 1),
+        ("exec", 0x7, 1 * MS, 6 * MS, None, "POTRF", 1),       # overlaps q
+        ("comm.get", 0x7, 2 * MS, 9 * MS, None, {"bytes": 1 << 16}, 2),
+        ("release", 0x7, 9 * MS, 10 * MS, None, None, 1),
+        ("exec", 0x7, 12 * MS, 14 * MS, None, "GEMM", 1),      # idle gap
+    ])
+    rep = attribute(sp)
+    rq = rep["requests"]["7"]
+    assert abs(sum(rq["buckets_ms"].values()) - rq["window_ms"]) < 1e-9
+    # priority: exec shadows queue on [1,3) and comm.get on [2,6)
+    bk = rq["buckets_ms"]
+    assert bk["exec"] == 7.0 and bk["queue"] == 1.0, bk
+    assert bk["comm.get"] == 3.0 and bk["idle"] == 2.0, bk
+    # per-task split saw both classes
+    assert rep["tasks"]["POTRF"]["count"] == 1
+    assert rep["tasks"]["GEMM"]["count"] == 1
+    # the GET flew 7ms, 4ms hidden behind POTRF -> 3ms lost
+    assert abs(rep["edges"]["comm.get:64kib"]["overlap_lost_ms"] - 3.0) \
+        < 1e-9
+
+
+def test_dag_critical_path_uses_measured_class_costs():
+    g = {("A", 0): [("B", 0)], ("B", 0): [("C", 0)], ("C", 0): []}
+    dag = dag_critical_path(g, {"A": 2.0, "B": 3.0, "C": 4.0})
+    assert dag["length"] == 9.0
+    assert [n[0] for n in dag["path"]] == ["A", "B", "C"]
+
+
+def test_summarize_recorder_disabled_returns_none():
+    prev = spans.recorder
+    if prev is not None:
+        spans.uninstall()
+    try:
+        assert spans.recorder is None
+        assert summarize_recorder() is None
+    finally:
+        if prev is not None:
+            spans.install(recorder_obj=prev)
+
+
+def test_cli_attributes_a_chrome_trace_on_disk(tmp_path):
+    evs = [{"name": "exec", "cat": "span", "ph": "X", "ts": 0.0,
+            "dur": 5000.0, "pid": 1, "tid": 1,
+            "args": {"trace": "c0de", "task": "GEMM"}},
+           {"name": "comm.get", "cat": "span", "ph": "X", "ts": 2000.0,
+            "dur": 6000.0, "pid": 1, "tid": 2,
+            "args": {"trace": "c0de", "bytes": 4 << 20,
+                     "flow": "get:0:1", "flow_side": "recv"}}]
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps({"traceEvents": evs}))
+    r = subprocess.run(
+        [sys.executable, "-m", "parsec_tpu.prof.critpath", str(p),
+         "--json"], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr[-1500:]
+    rep = json.loads(r.stdout)
+    assert rep["spans"] == 2 and "c0de" in rep["requests"]
+    assert rep["requests"]["c0de"]["buckets_ms"]["exec"] == 5.0
+    assert rep["edges"]["comm.get:4mib"]["overlap_lost_ms"] == 3.0
+    # human rendering too (no --json): the panel text
+    r2 = subprocess.run(
+        [sys.executable, "-m", "parsec_tpu.prof.critpath", str(p)],
+        capture_output=True, text=True, timeout=60)
+    assert r2.returncode == 0 and "overlap_lost" in r2.stdout
+
+
+def test_sharded_stream_critpath_attributes_control_plane_hops():
+    """ISSUE-16 satellite: a 2-rank traced stream under the sharded
+    serving plane — the SUBMIT crossing (frontend -> decode rank) and
+    the TOKENS/DONE crossings back must land as `serve.submit` /
+    `serve.tokens` edge classes on the stream's trace, charged to the
+    comm.activate bucket of that request's decomposition."""
+    from parsec_tpu.comm.multirank import run_multirank
+    from parsec_tpu.serve.sharded import ShardedRuntimeServer
+
+    bar = threading.Barrier(2)
+    prev = spans.recorder
+    if prev is not None:
+        spans.uninstall()
+    rec = spans.install()
+    try:
+        def body(ctx, rank, nranks):
+            srv = ShardedRuntimeServer(ctx)
+            bar.wait()
+            if rank == 0:
+                try:
+                    # burst of two: least-loaded placement parks the
+                    # second on rank 1 -> a genuinely remote stream
+                    ha = srv.submit_stream([3, 7, 11, 5],
+                                           max_new_tokens=6)
+                    hb = srv.submit_stream([21, 22, 23, 24],
+                                           max_new_tokens=6)
+                    srv.wait([ha, hb], timeout=120)
+                    remote = hb if hb.rank != 0 else ha
+                    assert remote.rank != 0, (ha.rank, hb.rank)
+                    return remote.trace
+                finally:
+                    srv.shutdown()
+                    bar.wait()
+            try:
+                srv.serve_forever(idle_timeout=180)
+            finally:
+                srv.close()
+                bar.wait()
+            return None
+
+        trace = run_multirank(2, body, nb_cores=1, timeout=180)[0]
+        assert trace, "submit_stream minted no trace under the recorder"
+        raw = list(rec.spans)
+    finally:
+        spans.uninstall()
+        if prev is not None:
+            spans.install(recorder_obj=prev)
+
+    rep = attribute(normalize(raw))
+    req = rep["requests"].get(format(trace, "x"))
+    assert req, sorted(rep["requests"])
+    # both control-plane hop kinds attributed as edge classes
+    assert any(c.startswith("serve.submit:") for c in rep["edges"]), \
+        sorted(rep["edges"])
+    assert any(c.startswith("serve.tokens:") for c in rep["edges"]), \
+        sorted(rep["edges"])
+    # ...and they charge the traced request's comm.activate bucket
+    assert req["buckets_ms"]["comm.activate"] > 0, req
+    # the emit/recv pairing really spanned the hop: both sides of at
+    # least one ssub flow are present on this trace
+    hop = [s for s in raw if s[0] == "serve.submit"
+           and int(s[1]) == int(trace)]
+    sides = {s[5].get("flow_side") for s in hop if isinstance(s[5], dict)}
+    assert sides == {"emit", "recv"}, hop
