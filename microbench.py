@@ -1107,6 +1107,61 @@ def bench_comm(smoke: bool = False) -> dict:
     return out
 
 
+def bench_tune(smoke: bool = False) -> dict:
+    """Autotuner plumbing costs (ISSUE 18): the search-harness overhead
+    per trial (no-op objective, so everything BUT the workload is on
+    the clock), and the tuning-DB consult latency over a populated
+    store through the cached generation-checked path — the
+    Context-start / per-tenant-submit probe the perf_smoke gate pins
+    at <= 50us."""
+    import os
+    import tempfile
+
+    from parsec_tpu.core.params import KnobSpec, params
+    from parsec_tpu.tune.db import TuneDB, cached_db
+    from parsec_tpu.tune.search import search
+
+    out: dict = {}
+    trials = 16 if smoke else 48
+    saved = params.get("perfdb")
+    with tempfile.TemporaryDirectory(prefix="tune_mb_") as d:
+        db = TuneDB(os.path.join(d, "tunedb.jsonl"))
+        space = {"a": KnobSpec(name="a", lo=1, hi=1 << 20, scale="log2"),
+                 "b": KnobSpec(name="b", values=("x", "y", "z"))}
+        params.set("perfdb", False)     # pure harness cost, no ledger I/O
+        # backend_signature's first call imports jax — a one-time
+        # process cost, not a per-trial one: warm it off the clock
+        from parsec_tpu.prof.perfdb import backend_signature
+        backend_signature()
+        try:
+            t0 = time.perf_counter()
+            res = search(lambda _k: 1.0, signature="microbench:noop",
+                         space=space, budget=trials, restarts=4,
+                         objective="cost_s", seed=3, db=db, persist=False)
+            dt = time.perf_counter() - t0
+        finally:
+            params.set("perfdb", saved)
+        out["tune_search_trials"] = res["evals"]
+        out["tune_search_overhead_us_per_trial"] = round(
+            dt / max(res["evals"], 1) * 1e6, 2)
+        # the consult path: 200 signatures' bests out of one parsed
+        # generation — the dict probe is what repeats per Context/tenant
+        nsig = 200
+        for i in range(nsig):
+            db.note(f"wl:mb:{i}", {"a": i + 1}, float(i + 1),
+                    objective="wall_s")
+        reps = 500 if smoke else 2000
+        cached_db(db.path).best("wl:mb:0", objective="wall_s")  # warm parse
+        t0 = time.perf_counter()
+        for i in range(reps):
+            cached_db(db.path).best(f"wl:mb:{i % nsig}",
+                                    objective="wall_s")
+        dt = time.perf_counter() - t0
+        out["tune_db_records"] = nsig
+        out["tune_db_lookup_us"] = round(dt / reps * 1e6, 3)
+    return out
+
+
 def run_all(smoke: bool = False, include_lowering: bool = True,
             include_serve: bool = True, include_comm: bool = True,
             include_llm: bool = True) -> dict:
@@ -1148,6 +1203,10 @@ def run_all(smoke: bool = False, include_lowering: bool = True,
             out.update(bench_lowering(smoke=smoke))
         except Exception as e:            # noqa: BLE001 — evidence over abort
             out["lowering_bench_error"] = f"{type(e).__name__}: {e}"
+    try:
+        out.update(bench_tune(smoke=smoke))
+    except Exception as e:            # noqa: BLE001 — evidence over abort
+        out["tune_bench_error"] = f"{type(e).__name__}: {e}"
     # persistent perf ledger (prof/perfdb.py): every scalar lands under
     # the microbench.run_all workload so consecutive runs accrue EWMA
     # history; MCA perfdb=0 disables, and a ledger failure never costs

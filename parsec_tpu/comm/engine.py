@@ -68,6 +68,11 @@ _params.register("comm_get_frag_bytes", 4 << 20,
 _params.register("comm_get_window", 4,
                  "max in-flight unacked fragments per GET (the sender-side "
                  "window; each landed fragment returns one credit)")
+# the autotuner's declared domains (docs/TUNING.md): fragment sizes move
+# in powers of two between 256KiB and 16MiB, the window between 1 and 16
+_params.declare_knob("comm_get_frag_bytes", lo=256 << 10, hi=16 << 20,
+                     scale="log2")
+_params.declare_knob("comm_get_window", lo=1, hi=16, scale="log2")
 
 
 class Capabilities:

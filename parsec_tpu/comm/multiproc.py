@@ -114,6 +114,14 @@ def _run_multiproc(nranks: int, target: str, timeout: float,
                  "comm_codec_pickle_fallback", "comm_bcast_tree",
                  "comm_coll_bench_bytes"):
         env.setdefault(f"PARSEC_MCA_{name}", str(_p.get(name)))
+    # forward the autotuner consult knobs the same way: every rank of a
+    # fabric must agree on WHETHER (and from which store) a persisted
+    # tuning vector applies, or ranks would run different knob vectors.
+    # lookup(), not get(): the parent may never have imported tune/
+    for name in ("tune_db", "tune_db_path", "tune_adaptive"):
+        p = _p.lookup(name)
+        if p is not None:
+            env.setdefault(f"PARSEC_MCA_{name}", str(p.value))
     env["PARSEC_MP_NRANKS"] = str(nranks)
     env["PARSEC_MP_TARGET"] = target
     env["PARSEC_MP_BASE_PORT"] = str(base)

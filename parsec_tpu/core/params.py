@@ -16,10 +16,11 @@ exactly as in the reference (SURVEY §5.6).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 from dataclasses import dataclass
-from typing import Any, Callable
+from typing import Any, Callable, Iterator
 
 _TYPES: dict[str, Callable[[str], Any]] = {
     "int": int,
@@ -57,6 +58,72 @@ class Param:
     value: Any = None
 
 
+@dataclass(frozen=True)
+class KnobSpec:
+    """The declared legal domain of ONE tunable param — what the
+    autotuner (``parsec_tpu/tune``) is allowed to move and where.  A
+    param without a spec is configuration, not a knob: no search or
+    persisted tuning vector may touch it.  ``values`` enumerates a
+    discrete domain (schedulers, storage backends); ``lo``/``hi`` bound
+    a numeric one, stepped multiplicatively when ``scale == "log2"``
+    (byte sizes, pool depths) or additively by ``step`` otherwise."""
+
+    name: str
+    values: tuple = ()
+    lo: float | None = None
+    hi: float | None = None
+    scale: str = "linear"           # "linear" | "log2"
+    step: float = 1.0
+
+    def neighbors(self, cur: Any) -> list:
+        """The coordinate-descent moves from ``cur``: adjacent
+        enumerated values, or the one-step up/down numeric moves,
+        clamped to the declared bounds."""
+        if self.values:
+            vals = list(self.values)
+            if cur not in vals:
+                return vals
+            i = vals.index(cur)
+            return [vals[j] for j in (i - 1, i + 1)
+                    if 0 <= j < len(vals)]
+        out = []
+        for nxt in ((cur * 2, cur / 2) if self.scale == "log2"
+                    else (cur + self.step, cur - self.step)):
+            if self.lo is not None:
+                nxt = max(nxt, self.lo)
+            if self.hi is not None:
+                nxt = min(nxt, self.hi)
+            if isinstance(cur, int):
+                nxt = int(round(nxt))
+            if nxt != cur and nxt not in out:
+                out.append(nxt)
+        return out
+
+    def contains(self, v: Any) -> bool:
+        if self.values:
+            return v in self.values
+        ok = True
+        if self.lo is not None:
+            ok = ok and v >= self.lo
+        if self.hi is not None:
+            ok = ok and v <= self.hi
+        return ok
+
+    def sample(self, rng) -> Any:
+        """One random restart point (``rng``: ``random.Random``)."""
+        if self.values:
+            return rng.choice(list(self.values))
+        lo = self.lo if self.lo is not None else 1
+        hi = self.hi if self.hi is not None else max(lo, 1) * 64
+        if self.scale == "log2":
+            import math
+            e = rng.uniform(math.log2(max(lo, 1e-9)), math.log2(hi))
+            v = 2.0 ** e
+        else:
+            v = rng.uniform(lo, hi)
+        return int(round(v)) if isinstance(lo, int) else v
+
+
 class ParamRegistry:
     """Process-global registry of typed parameters."""
 
@@ -65,6 +132,7 @@ class ParamRegistry:
         self._params: dict[str, Param] = {}
         self._cli_overrides: dict[str, str] = {}
         self._file_values: dict[str, str] = {}
+        self._knobs: dict[str, KnobSpec] = {}
 
     # -- registration (cf. parsec_mca_param_reg_int_name etc.) --------------
     def register(
@@ -106,6 +174,12 @@ class ParamRegistry:
         return p.default, "default"
 
     # -- lookup / mutation ---------------------------------------------------
+    def lookup(self, name: str) -> Param | None:
+        """The registered Param record (value + provenance) or None —
+        never registers (register() would mint a default)."""
+        with self._lock:
+            return self._params.get(name)
+
     def get(self, name: str, default: Any = None) -> Any:
         with self._lock:
             p = self._params.get(name)
@@ -123,6 +197,73 @@ class ParamRegistry:
             if p.read_only:
                 raise PermissionError(f"param {name} is read-only")
             p.value, p.source = _TYPES[p.type](str(value)), "set"
+
+    # -- knob space (the autotuner's declared search domain) -----------------
+    def declare_knob(self, name: str, values: tuple | list = (),
+                     lo: float | None = None, hi: float | None = None,
+                     scale: str = "linear", step: float = 1.0) -> KnobSpec:
+        """Declare ``name`` (a registered param — or one registered
+        later) tunable over the given domain.  Declared at the param's
+        point of registration, consumed by ``parsec_tpu/tune``: the
+        search and every persisted knob vector are confined to declared
+        knobs, so a stale tuning DB can never set an undeclared param.
+        Idempotent per name (first declaration wins, matching
+        :meth:`register`)."""
+        with self._lock:
+            spec = self._knobs.get(name)
+            if spec is None:
+                spec = KnobSpec(name=name, values=tuple(values), lo=lo,
+                                hi=hi, scale=scale, step=step)
+                self._knobs[name] = spec
+            return spec
+
+    def knob_space(self) -> dict[str, KnobSpec]:
+        with self._lock:
+            return dict(self._knobs)
+
+    def knob_spec(self, name: str) -> KnobSpec | None:
+        with self._lock:
+            return self._knobs.get(name)
+
+    # -- scoped overrides (one trial's knob vector) --------------------------
+    @contextlib.contextmanager
+    def overrides(self, knobs: dict[str, Any]) -> Iterator[None]:
+        """Apply ``knobs`` for the dynamic extent of the ``with`` block
+        and restore each param's prior ``(value, source)`` pair on exit
+        — a later ``_refresh_locked`` (cmdline/paramfile parse) then
+        still re-resolves params the block touched, because a restored
+        ``env``/``default`` source stays refreshable where a plain
+        ``set()`` would have pinned it.  Unregistered names raise
+        KeyError BEFORE anything is applied, so a failed vector never
+        half-applies."""
+        saved: dict[str, tuple[Any, str]] = {}
+        with self._lock:
+            missing = [n for n in knobs if n not in self._params]
+            if missing:
+                raise KeyError(f"unregistered param(s): {missing}")
+            for name, value in knobs.items():
+                p = self._params[name]
+                if p.read_only:
+                    raise PermissionError(f"param {name} is read-only")
+                saved[name] = (p.value, p.source)
+                p.value, p.source = _TYPES[p.type](str(value)), "set"
+        try:
+            yield
+        finally:
+            with self._lock:
+                for name, (v, src) in saved.items():
+                    p = self._params.get(name)
+                    if p is not None:
+                        p.value, p.source = v, src
+
+    def snapshot(self) -> dict[str, Any]:
+        """The full resolved knob vector: every registered param's
+        current value (scalars only — exactly what a perf ledger entry
+        or tuning-DB trial needs to be distinguishable from a
+        default-knob run)."""
+        with self._lock:
+            return {name: p.value for name, p in sorted(self._params.items())
+                    if isinstance(p.value, (bool, int, float, str))}
 
     # -- external sources ----------------------------------------------------
     def parse_cmdline(self, argv: list[str]) -> list[str]:
@@ -179,6 +320,7 @@ class ParamRegistry:
             self._params.clear()
             self._cli_overrides.clear()
             self._file_values.clear()
+            self._knobs.clear()
 
 
 params = ParamRegistry()

@@ -86,6 +86,10 @@ _params.register("llm_steps_per_pool", 8,
                  "between steps): the host loop and its submit/termdet "
                  "overhead run once per k tokens; 1 = the PR-6 "
                  "step-per-pool behavior")
+# the autotuner's declared domain (docs/TUNING.md): superpool depth
+# moves in powers of two; past ~32 the step-timeout and per-stream
+# budget clipping dominate, so the search never wanders further
+_params.declare_knob("llm_steps_per_pool", lo=1, hi=32, scale="log2")
 _params.register("llm_compiled_pools", True,
                  "submit decode superpools through the funneled "
                  "compiled-DAG executor (runtime/dagrun.py, PR 2's "
@@ -365,6 +369,15 @@ class ContinuousBatcher:
         # converged, so undraftable workloads don't pay the cap->0
         # descent once per stream — only the staggered probes remain
         self._spec_prior: dict[str, float] = {}
+        # per-tenant live adaptation of llm_steps_per_pool (ISSUE 18,
+        # ``tune_adaptive=1``): one hysteresis EWMA controller per
+        # tenant (tune/adaptive.KnobController), fed the same observed
+        # inter-token latency the SLO plane quantiles.  _k_seed holds
+        # the tuning-DB start points RuntimeServer's per-tenant consult
+        # hands over before the controller exists (GIL-atomic dict
+        # writes; controllers themselves live on the batcher thread)
+        self._k_ctl: dict[str, Any] = {}
+        self._k_seed: dict[str, int] = {}
         self._pool_seq = itertools.count()
         _live_batchers.add(self)
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -424,6 +437,29 @@ class ContinuousBatcher:
         self._wake.set()
         return ticket
 
+    def seed_tenant_knobs(self, tenant: str, knobs: dict) -> None:
+        """Seed a tenant's adaptive start point from a persisted knob
+        vector (the RuntimeServer per-tenant tuning-DB consult) —
+        consumed when that tenant's controller is created lazily."""
+        k = knobs.get("llm_steps_per_pool")
+        if isinstance(k, (int, float)) and not isinstance(k, bool) \
+                and k >= 1:
+            self._k_seed[tenant] = int(k)
+
+    def _tenant_k(self, tenant: str, k_max: int) -> int:
+        """The tenant's pool depth this iteration: the global
+        ``llm_steps_per_pool`` unless live adaptation is on, then the
+        tenant's controller value (seeded from the tuning DB when a
+        vector was stored).  Batcher thread only."""
+        if not _params.get("tune_adaptive", False):
+            return k_max
+        ctl = self._k_ctl.get(tenant)
+        if ctl is None:
+            from ..tune.adaptive import steps_controller
+            ctl = steps_controller(tenant, self._k_seed.get(tenant, k_max))
+            self._k_ctl[tenant] = ctl
+        return max(1, int(ctl.value))
+
     # -- placement hooks (serve/sharded.py) ------------------------------
     def residency_len(self, prompt_tokens) -> int:
         """How many leading TOKENS of a prospective prompt are already
@@ -464,6 +500,9 @@ class ContinuousBatcher:
         if out["spec_submits"]:
             out["spec_tokens_per_submit"] = round(
                 out["spec_tokens"] / out["spec_submits"], 4)
+        if self._k_ctl:
+            out["adaptive_k"] = {t: c.stats()
+                                 for t, c in self._k_ctl.items()}
         out["kv"] = self.kv.stats()
         out["tiers"] = self.tiers.stats()
         if self.prefix is not None:
@@ -946,12 +985,23 @@ class ContinuousBatcher:
                     st.tenant, "ttft_ms",
                     (st.ticket.first_token_at
                      - st.ticket.submitted_at) * 1e3)
-        if self._slo is not None and toks:
+        if toks:
             # every token samples the inter-token latency (this
             # iteration's wall amortized over its k tokens)
             tok_ms = dt / len(toks) * 1e3
-            for _ in toks:
-                self._slo.observe(st.tenant, "tok_latency_ms", tok_ms)
+            if self._slo is not None:
+                for _ in toks:
+                    self._slo.observe(st.tenant, "tok_latency_ms", tok_ms)
+            ctl = self._k_ctl.get(st.tenant)
+            if ctl is not None:
+                # the adaptive plane folds the same signal; a converged
+                # adoption persists to the tuning DB exactly once
+                ctl.observe(tok_ms)
+                wb = ctl.take_writeback()
+                if wb is not None:
+                    from ..tune import adaptive as _adaptive
+                    _adaptive.writeback(st.tenant, wb,
+                                        ctl.ewma_of(wb) or tok_ms)
         with self._lock:
             st.ticket.tokens.extend(toks)
             st.ticket.per_token_s.extend([dt] * len(toks))
@@ -1005,7 +1055,7 @@ class ContinuousBatcher:
                                       st.cur, draft, spec_cap + 1,
                                       eos=st.eos)
                 else:
-                    st.k = max(1, min(k_max,
+                    st.k = max(1, min(self._tenant_k(st.tenant, k_max),
                                       st.max_new - len(st.ticket.tokens)))
                     st.spec = False
                     preallocate_decode_steps(self.kv, st.seq, st.k)

@@ -483,6 +483,25 @@ def runtime_report(max_workers: int = 6) -> dict:
         cp = _best_effort(_critpath, default={})
         if cp:
             rep["critpath"] = cp
+    # the resolved MCA knob vector (ISSUE 18): every DECLARED tuning
+    # knob plus any param resolved away from its default, so any report
+    # answers "under WHICH configuration was this measured" — the
+    # provenance the tuning DB and the perf ledger key on.  Defaults
+    # are derivable from the code version, so omitting them keeps the
+    # report inside its compactness contract.  Nested, so note_result's
+    # scalar walk never mistakes a knob for a measurement.  Precedes
+    # the flightrec-disabled early return: a report always carries it.
+    def _knobs():
+        from ..core.params import params as _p
+        snap = _p.snapshot()
+        keep = set(_p.knob_space())
+        for name in snap:
+            p = _p.lookup(name)
+            if p is not None and \
+                    getattr(p, "source", "default") != "default":
+                keep.add(name)
+        return {n: snap[n] for n in sorted(keep) if n in snap}
+    rep["knobs"] = _best_effort(_knobs, default={})
     r = recorder
     if r is None:
         rep["flightrec"] = "disabled"

@@ -213,6 +213,17 @@ class PerfDB:
         self.append(key, value, unit=unit, run=run)
         return out
 
+    # -- trial provenance (the autotuner hook) ---------------------------
+    def note_trial(self, workload: str, objective: str, value: float, *,
+                   knobs: dict | None = None, meta: dict | None = None,
+                   backend: list | None = None) -> dict:
+        """Append one autotuner trial (``parsec_tpu/tune``): the knob
+        vector IS the key's knobs field, so each candidate point accrues
+        its own EWMA history — which is exactly what lets a later search
+        prune a known-bad vector without re-measuring it."""
+        key = make_key(workload, objective, backend=backend, knobs=knobs)
+        return self.append(key, float(value), run="tune", meta=meta)
+
     # -- bulk note (the bench / microbench hook) -------------------------
     def note_result(self, workload: str, result: dict, *,
                     knobs: dict | None = None, run: str | None = None,

@@ -60,7 +60,7 @@ from ..data.data import ACCESS_RW, ACCESS_WRITE
 __all__ = ["LoweringError", "register_traceable", "find_traceable",
            "lower_taskpool", "LoweredTaskpool", "lowering_cache",
            "lower_regions", "RegionLoweredTaskpool", "LoweredRegion",
-           "warm_cache"]
+           "warm_cache", "structural_fingerprint"]
 
 _params.register(
     "lowering_scan_min", 4,
@@ -88,6 +88,10 @@ _params.register(
     "XLA program each — smaller regions mean cheaper per-region compiles "
     "under lowering_compile_budget_s, more runtime boundaries; 0 lowers "
     "each weakly-connected component whole")
+# the autotuner's declared domain (docs/TUNING.md): region caps move in
+# powers of two between tiny (cheap compiles, many boundaries) and 1024
+_params.declare_knob("lowering_region_max_tasks", lo=16, hi=1024,
+                     scale="log2")
 _params.register(
     "lowering_compile_budget_s", 0.0,
     "wall-clock budget for staged region compilation (smallest region "
@@ -267,6 +271,55 @@ def _backend_signature() -> tuple:
     except Exception:
         kind = ""
     return (jax.__version__, jax.default_backend(), kind)
+
+
+def structural_fingerprint(obj) -> dict:
+    """Cross-process-stable structural summary of a taskpool — the tune
+    subsystem's signature seam (``parsec_tpu/tune/signature.py``,
+    docs/TUNING.md).
+
+    The in-process lowering signatures (:func:`_freeze`) key callables
+    by IDENTITY, which is exactly right for an executable cache and
+    exactly wrong for a persistent tuning DB: two processes lowering the
+    same program would never agree.  This export keeps only the stable
+    axes those signatures discriminate on — task classes (name, task
+    count, kernel NAME, flow names), the wavefront shape (level count,
+    widest level), and, when handed an already-lowered pool, the chosen
+    mode and per-store row geometry — as a plain JSON-able dict.
+    Accepts a Taskpool or a :class:`LoweredTaskpool`."""
+    low = obj if isinstance(obj, LoweredTaskpool) else None
+    tp = low.taskpool if low is not None else obj
+    infos = _analyze(tp)
+    classes = []
+    total = 0
+    for cname in sorted(infos):
+        ci = infos[cname]
+        k = ci.kernel
+        kname = ""
+        if k is not None:
+            kname = (getattr(k, "name", None)
+                     or getattr(getattr(k, "fn", None), "__name__", "")
+                     or "")
+        total += len(ci.tasks)
+        classes.append([cname, len(ci.tasks), kname,
+                        sorted(f.name for f in ci.data_flows),
+                        sorted(f.name for f in ci.writable_flows)])
+    fp: dict = {"classes": classes, "ntasks": total}
+    try:
+        _order, levels = _task_graph(tp, infos)
+        if levels:
+            widths: dict[int, int] = {}
+            for lv in levels.values():
+                widths[lv] = widths.get(lv, 0) + 1
+            fp["wavefront"] = [1 + max(levels.values()),
+                               max(widths.values())]
+    except LoweringError:
+        pass        # irregular graph: the class table still discriminates
+    if low is not None:
+        fp["mode"] = low.mode
+        fp["stores"] = {name: int(low._stores.nrows.get(name, 0))
+                        for name in sorted(low._stores.dcs)}
+    return fp
 
 
 _pcache_done = False

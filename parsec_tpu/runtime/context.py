@@ -37,6 +37,12 @@ _params.register("runtime_bind_threads", False,
                  "pin worker threads to cores round-robin "
                  "(parsec_bind / hwloc binding analog; Linux only)")
 _params.register("sched", "lfq", "scheduler component to use")
+# the autotuner's declared domain (docs/TUNING.md): the general-purpose
+# scheduler modules (sched/modules.py) — serve_fair is a serving shim
+# the RuntimeServer interposes itself, never a search move
+_params.declare_knob("sched", values=("lfq", "ap", "spq", "ip", "gd",
+                                      "rnd", "ll", "llp", "pbq", "ltq",
+                                      "lhq"))
 _params.register("termdet", "", "termination detector override")
 _params.register("runtime_nb_vp", 1, "number of virtual processes")
 _params.register("props_stream", "",
@@ -91,6 +97,15 @@ class Context:
         # any worker runs, so a traced pool's first task is never missed
         from ..prof import spans as _spans
         _spans.ensure_installed()
+        # persisted tuning vector (parsec_tpu/tune, ``tune_db=1``): the
+        # ambient ``context`` consult applies a stored knob vector NOW —
+        # before the core-count read and the scheduler query below
+        # resolve the params it may set (env/cli pins always win)
+        try:
+            from ..tune import apply_ambient
+            self.tuned_knobs = apply_ambient("context")
+        except Exception:               # noqa: BLE001 — a corrupt tuning
+            self.tuned_knobs = None     # DB must never fail a start
         if nb_cores is None:
             nb_cores = _params.get("runtime_num_cores")
         self.nb_cores = nb_cores

@@ -28,6 +28,7 @@ _params.register(
     "default: non-eligible classes fall back to the hashed tier, and "
     "batched release takes one lock per class group) or 'hash' "
     "(parsec_hash_find_deps only)")
+_params.declare_knob("deps_storage", values=("index-array", "hash"))
 _params.register(
     "deps_index_array_max_slots", 1 << 22,
     "largest static-box volume (slots) the index-array tier will "

@@ -222,6 +222,11 @@ class RuntimeServer:
         self.failed = 0
         self.rejected = 0
         self.per_tenant_completed: dict[str, int] = {}
+        # per-tenant tuning-DB consult memo (parsec_tpu/tune,
+        # ``tune_db=1``): a tenant's FIRST submit_stream probes
+        # ``ambient:tenant:<t>`` once and seeds the batcher's adaptive
+        # controller from the stored vector
+        self._tenant_consulted: set[str] = set()
         self._llm = None            # lazy ContinuousBatcher (submit_stream)
         # the per-tenant SLO metrics plane (prof/histogram.py): queue
         # wait, end-to-end latency, admission sheds here; the LLM
@@ -438,6 +443,15 @@ class RuntimeServer:
                 own = self._ctx.my_rank if self._ctx.nb_ranks > 1 else None
                 self._llm = ContinuousBatcher(self, owner_rank=own)
             llm = self._llm
+            if tenant not in self._tenant_consulted:
+                self._tenant_consulted.add(tenant)
+                try:
+                    from ..tune import consult_ambient
+                    knobs = consult_ambient(f"tenant:{tenant}")
+                    if knobs:
+                        llm.seed_tenant_knobs(tenant, knobs)
+                except Exception:       # noqa: BLE001 — a corrupt tuning
+                    pass                # DB must never shed a stream
         return llm.submit_stream(prompt_tokens,
                                  max_new_tokens=max_new_tokens,
                                  tenant=tenant, priority=priority,

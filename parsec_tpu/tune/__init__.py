@@ -1,0 +1,95 @@
+"""Closed-loop autotuner (ISSUE 18): signature-keyed knob search over
+the perf ledger, with live per-tenant adaptation.
+
+Three parts (docs/TUNING.md):
+
+- :mod:`~parsec_tpu.tune.signature` + :mod:`~parsec_tpu.tune.search` —
+  a workload's structural signature (derived from the PR-2 lowering
+  machinery) keys a budgeted coordinate-descent search over the
+  DECLARED knob space (``core/params.KnobSpec``), each trial running
+  under a scoped MCA override and recorded to the perf ledger;
+- :mod:`~parsec_tpu.tune.db` — the persistent tuning DB
+  (``tunedb.jsonl``) consulted at ``Context`` start and per-tenant
+  submit (``tune_db=1``);
+- :mod:`~parsec_tpu.tune.adaptive` — the generalized PR-12 EWMA
+  controller resizing ``llm_steps_per_pool`` per tenant live
+  (``tune_adaptive=1``), converged values written back to the DB.
+
+``python -m parsec_tpu.tune --self-test`` runs the synthetic
+quadratic-basin gate wired into ``scripts/check.sh``.
+"""
+
+from __future__ import annotations
+
+from ..core.params import params as _params
+from .db import TuneDB, best, cached_db, default_path, make_key  # noqa: F401
+from .signature import (ambient_signature, size_bucket,  # noqa: F401
+                        workload_signature)
+
+__all__ = ["TuneDB", "best", "cached_db", "default_path", "make_key",
+           "ambient_signature", "size_bucket", "workload_signature",
+           "search", "KnobController", "apply_ambient", "consult_ambient"]
+
+
+def __getattr__(name: str):
+    # the heavy halves load on first use: importing parsec_tpu.tune from
+    # Context.__init__ must not drag the search/adaptive machinery in
+    if name == "search":
+        from .search import search
+        return search
+    if name == "KnobController":
+        from .adaptive import KnobController
+        return KnobController
+    raise AttributeError(name)
+
+
+def consult_ambient(tag: str, *, objective: str | None = None
+                    ) -> dict | None:
+    """The stored knob vector for an ambient tag (``context``,
+    ``tenant:<t>``), or ``None``: gate (``tune_db``), cached-store probe,
+    declared-knob filter — but no application.  Any objective matches
+    when ``objective`` is None (ambient tags rarely carry more than
+    one)."""
+    if not _params.get("tune_db"):
+        return None
+    try:
+        db = cached_db()
+        sig = ambient_signature(tag)
+        if objective is not None:
+            rec = db.best(sig, objective=objective)
+        else:
+            rec = None
+            for r in db._index().values():
+                if r.get("sig") == sig:
+                    rec = r if rec is None or r["ts"] > rec["ts"] else rec
+    except Exception:                   # noqa: BLE001 — a corrupt DB must
+        return None                     # never fail a Context start
+    if rec is None:
+        return None
+    space = _params.knob_space()
+    knobs = {n: v for n, v in rec["knobs"].items()
+             if n in space and space[n].contains(v)}
+    return knobs or None
+
+
+def apply_ambient(tag: str) -> dict | None:
+    """Consult + APPLY: set every declared, registered knob from the
+    stored vector — skipping knobs the operator pinned via env/cli (an
+    explicit override always wins over a persisted tuning).  Returns
+    the dict actually applied, or ``None`` on miss/disabled."""
+    knobs = consult_ambient(tag)
+    if not knobs:
+        return None
+    applied: dict = {}
+    for name, value in knobs.items():
+        p = _params.lookup(name)
+        if p is None:                   # owning module not loaded yet:
+            continue                    # nothing to apply the knob to
+        if p.source in ("env", "cli"):
+            continue
+        try:
+            _params.set(name, value)
+            applied[name] = _params.get(name)
+        except Exception:               # noqa: BLE001 — one bad knob must
+            continue                    # not lose the rest of the vector
+    return applied or None

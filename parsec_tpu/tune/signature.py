@@ -1,0 +1,68 @@
+"""Workload signatures: what a stored knob vector is FOR (ISSUE 18).
+
+Two kinds, both plain strings (the tuning DB's first key column,
+``tune/db.py``):
+
+- **structural** (:func:`workload_signature`): derived from the PR-2
+  lowering-cache machinery — the task-class table (names, task counts,
+  kernel names, flow names), the wavefront shape, store row geometry
+  when lowered — plus a power-of-two **size bucket**, digested to a
+  short stable hex.  The in-process lowering signature freezes kernels
+  by object identity (``lowering._freeze``), which can never agree
+  across processes; :func:`parsec_tpu.ptg.lowering.structural_fingerprint`
+  re-expresses the same axes by *name*, so two processes lowering the
+  same program land on the same signature — the property the
+  persistence tests pin.  The backend triple deliberately stays OUT of
+  the signature: it is the DB key's second column, so "same structure,
+  different backend" is a key miss, not a false hit.
+
+- **ambient** (:func:`ambient_signature`): a tag for vectors applied
+  before any workload structure exists — ``ambient:context`` at
+  :class:`~parsec_tpu.runtime.context.Context` start,
+  ``ambient:tenant:<t>`` at RuntimeServer per-tenant submit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any
+
+
+def size_bucket(n: float | int) -> int:
+    """Power-of-two bucket of a workload size (task count, matrix n,
+    token count...): vectors tuned at n=8192 apply at n=8192+epsilon,
+    never at n=64."""
+    n = int(n)
+    return 0 if n <= 1 else n.bit_length() - 1
+
+
+def fingerprint(obj) -> dict:
+    """The structural fingerprint dict (see
+    :func:`parsec_tpu.ptg.lowering.structural_fingerprint`) — exposed
+    here so signature consumers need not import the lowering module."""
+    from ..ptg.lowering import structural_fingerprint
+    return structural_fingerprint(obj)
+
+
+def workload_signature(obj: Any, size_hint: float | None = None) -> str:
+    """Structural signature of a Taskpool / LoweredTaskpool.
+
+    ``size_hint`` overrides the bucketed size axis (default: the
+    fingerprint's total task count) — callers whose task count hides
+    the real scale (one decode pool per iteration, say) pass tokens or
+    matrix n instead."""
+    fp = fingerprint(obj)
+    bucket = size_bucket(size_hint if size_hint is not None
+                         else fp.get("ntasks", 0))
+    blob = json.dumps({"fp": fp, "bucket": bucket}, sort_keys=True,
+                      separators=(",", ":")).encode()
+    digest = hashlib.blake2b(blob, digest_size=10).hexdigest()
+    # a human-scannable prefix (first class name) + the discriminating
+    # digest: `--history`-style tooling stays readable
+    head = fp["classes"][0][0] if fp.get("classes") else "empty"
+    return f"wl:{head}:b{bucket}:{digest}"
+
+
+def ambient_signature(tag: str) -> str:
+    return f"ambient:{tag}"

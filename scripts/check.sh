@@ -43,6 +43,14 @@ else
     python -m pytest tests/test_critpath.py tests/test_perf_smoke.py -q \
         -k "perfdb or critpath" -p no:cacheprovider
 
+    echo "== tune (closed-loop autotuner self-test: quadratic-basin" \
+         "search, scoped override restore, tunedb round-trip + ambient" \
+         "consult) =="
+    python -m parsec_tpu.tune --self-test
+    python -m pytest tests/test_tune.py -q -p no:cacheprovider
+    python -m pytest tests/test_perf_smoke.py -q -k tune \
+        -p no:cacheprovider
+
     echo "== tracing overhead gate (disabled span path within 10% of" \
          "the overhead baseline; allocation-free; enabled <=1us budget" \
          "at headroom) =="

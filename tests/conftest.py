@@ -17,6 +17,16 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# the autotuner consult (parsec_tpu/tune) must be hermetic under test: a
+# leftover /tmp/tunedb.jsonl from a bench run on the same box must never
+# steer test Contexts.  env-level default, so tests that probe the
+# consult path still override it with params.set / their own stores.
+if "PARSEC_MCA_tune_db_path" not in os.environ:
+    import tempfile
+
+    os.environ["PARSEC_MCA_tune_db_path"] = os.path.join(
+        tempfile.mkdtemp(prefix="parsec_test_tune_"), "tunedb.jsonl")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -335,3 +335,53 @@ def test_region_lowering_xla_call_drop_and_warm_compile():
     assert r["lowering_region_xla_call_drop"] >= REGION_XLA_CALL_DROP_MIN, r
     assert r["lowering_region_compile_warm_s"] <= \
         REGION_COMPILE_WARM_S_MAX, r
+
+
+# ISSUE-18 closed-loop autotuner budgets (docs/TUNING.md overhead
+# table): a tuning-DB consult sits on Context start and on the first
+# submit of every tenant, so the cached lookup must stay deep in the
+# noise (measured ~17µs parse-warm over 200 signatures; the issue pins
+# the 50µs line).  The search harness itself — scoped overrides, trial
+# memo, perfdb prior probe, JSONL note per trial — measured ~59µs/trial
+# against a no-op objective; gated at ~30x headroom so only a
+# structural regression (re-parsing the DB per trial, re-importing jax
+# inside the loop) trips it.
+TUNE_DB_LOOKUP_US_MAX = 50.0
+TUNE_SEARCH_OVERHEAD_US_PER_TRIAL_MAX = 2000.0
+TUNE_SPEEDUP_MIN = 1.2
+
+
+def test_tune_search_and_db_overhead():
+    r = microbench.bench_tune(smoke=True)
+    assert r["tune_db_lookup_us"] <= TUNE_DB_LOOKUP_US_MAX, r
+    assert r["tune_search_overhead_us_per_trial"] <= \
+        TUNE_SEARCH_OVERHEAD_US_PER_TRIAL_MAX, r
+    # the lookup gate measured against a real population, not one row
+    assert r["tune_db_records"] >= 200, r
+
+
+def test_tuned_cholesky_recovers_seeded_bad_tile(param, tmp_path):
+    """The ISSUE-18 acceptance headline: handed a deliberately
+    mis-tiled dynamic Cholesky (nb far too small, dispatch-bound), the
+    autotuner must claw back >= 1.2x within its trial budget and leave
+    the winner in tunedb.jsonl.  Measured ~10x on the smoke shape — the
+    gate only fails if the loop stops moving the knob, scores the wrong
+    run, or loses the steady-state warmup discipline."""
+    import bench
+    from parsec_tpu.core.params import params
+    from parsec_tpu.device import registry
+    params.register("device_tpu_allow_cpu", False)
+    param("device_tpu_allow_cpu", True)
+    param("tune_db_path", str(tmp_path / "tunedb.jsonl"))
+    param("perfdb", False)
+    snapshot = list(registry.devices)
+    try:
+        r = bench.bench_tuned_cholesky(n=256, nb_bad=32, budget=4)
+    finally:
+        registry.devices = snapshot
+        for i, d in enumerate(registry.devices):
+            d.device_index = i
+    assert r["tune_speedup"] >= TUNE_SPEEDUP_MIN, r
+    assert r["best_nb"] != r["nb_bad"], r
+    assert r["tile00_abs_err"] <= 1e-3, r
+    assert Path(r["db_path"]).exists(), r
