@@ -812,7 +812,15 @@ def bench_comm_ranks_stage() -> dict:
     and the ROOT's egress bytes — the number the tree exists to bound:
     ~⌈log₂ n⌉ payload transfers instead of n-1.  Each completed rank
     count flushes through ``_note_partial`` so a deadline death keeps
-    the finished points."""
+    the finished points.
+
+    Each point also carries the static-vs-dynamic agreement cross-check
+    (ISSUE 20): ``analysis/commcheck.predict_collective_traffic`` derives
+    the expected cross-rank payload bytes per edge class WITHOUT running
+    anything, and ``comm_agree_{n}r_err`` is the relative disagreement
+    against the measured ``peer_stats`` wire ledger — perfdb verdicts it
+    lower-is-better, so drift between the static model and the wire
+    shows up in the regression sentinel."""
     import os
 
     from parsec_tpu.comm.multiproc import run_multiproc
@@ -839,6 +847,24 @@ def bench_comm_ranks_stage() -> dict:
                 egress / payload, 2) if payload else 0.0,
             f"bcast_{nranks}r_identical": len(digests) == 1,
         }
+        try:
+            # partials must never raise: the cross-check is advisory here
+            # (tests/test_perf_smoke.py gates it)
+            from parsec_tpu.analysis.commcheck import (
+                agreement_rel_err, predict_collective_traffic)
+            pred = predict_collective_traffic(nranks)
+            observed = sum(
+                d["bytes"]
+                for r in res
+                for d in r["peer_stats"].get("tx", {}).values())
+            point[f"comm_pred_{nranks}r_bytes"] = pred["total_bytes"]
+            point[f"comm_agree_{nranks}r_err"] = round(
+                agreement_rel_err(pred["total_bytes"], observed), 4)
+            _note_partial(phase="measure", ranks_done=nranks,
+                          **{f"pred_{nranks}r_{ec}": b for ec, b
+                             in sorted(pred["edge_bytes"].items())})
+        except Exception:
+            pass
         out.update(point)
         _note_partial(phase="measure", ranks_done=nranks, **point)
     return out

@@ -1107,6 +1107,38 @@ def bench_comm(smoke: bool = False) -> dict:
     return out
 
 
+def bench_commcheck(smoke: bool = False) -> dict:
+    """Static comm-pattern derivation cost (ISSUE 20): the analyzer's own
+    wall time and tasks/s over a distributed broadcast pool, plus the
+    rank-sweep prediction latency bench.py's ``comm_ranks`` cross-check
+    pays per point — commcheck runs in the CI gate and before real
+    submissions, so its replay must stay cheap relative to the graphs it
+    clears."""
+    from parsec_tpu.analysis.commcheck import (check_comm,
+                                               predict_collective_traffic)
+    from parsec_tpu.comm.collectives import bcast_taskpool
+    from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
+
+    out: dict = {}
+    n = 16 if smoke else 64
+    reps = 2 if smoke else 3
+    best = None
+    for _ in range(reps):
+        V = VectorTwoDimCyclic("V", lm=1024 * n, mb=1024, P=min(n, 8))
+        tp = bcast_taskpool(V, n=n)
+        t0 = time.perf_counter()
+        cr = check_comm(tp, nb_ranks=min(n, 8))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    assert cr.pattern == "broadcast", cr
+    out["commcheck_derive_s"] = round(best, 4)
+    out["commcheck_tasks_per_s"] = round(cr.ntasks / max(best, 1e-9), 1)
+    t0 = time.perf_counter()
+    predict_collective_traffic(4, payload_bytes=1 << 16)
+    out["commcheck_predict_s"] = round(time.perf_counter() - t0, 4)
+    return out
+
+
 def bench_tune(smoke: bool = False) -> dict:
     """Autotuner plumbing costs (ISSUE 18): the search-harness overhead
     per trial (no-op objective, so everything BUT the workload is on
@@ -1207,6 +1239,10 @@ def run_all(smoke: bool = False, include_lowering: bool = True,
         out.update(bench_tune(smoke=smoke))
     except Exception as e:            # noqa: BLE001 — evidence over abort
         out["tune_bench_error"] = f"{type(e).__name__}: {e}"
+    try:
+        out.update(bench_commcheck(smoke=smoke))
+    except Exception as e:            # noqa: BLE001 — evidence over abort
+        out["commcheck_bench_error"] = f"{type(e).__name__}: {e}"
     # persistent perf ledger (prof/perfdb.py): every scalar lands under
     # the microbench.run_all workload so consecutive runs accrue EWMA
     # history; MCA perfdb=0 disables, and a ledger failure never costs

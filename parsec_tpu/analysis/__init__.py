@@ -14,6 +14,13 @@ lint over the runtime's own source — two prongs, one entry point:
   out-of-range indices).  Findings carry task-class/flow/instance
   provenance; :func:`check_taskpool` raises :class:`GraphCheckError` in
   gate mode.
+- :mod:`.commcheck` — replay graphcheck's retained concrete graph against
+  each collection's ``rank_of`` affinity and derive, without executing,
+  every pool's cross-rank traffic: per-edge-class byte counts (flow name
+  × pow-2 size tier, the ``prof/critpath`` keying), per-rank
+  fan-out/fan-in, a pattern classification (broadcast / reduce / halo /
+  point-to-point / all-to-all / none), static comm-hazard findings, and
+  :func:`recommend_tree` per-edge-class tree shapes (docs/ANALYSIS.md).
 - :mod:`.runtimelint` — an AST lint over ``parsec_tpu/`` itself enforcing
   the concurrency contracts the hot paths rely on: attributes declared
   lock-protected (module-level ``_LOCK_PROTECTED`` registries) may only be
@@ -42,14 +49,25 @@ __all__ = [
     "check_taskpool", "check_ptg", "check_dtd", "check_jdf",
     "Region", "select_regions", "task_levels",
     "LintReport", "lint_file", "lint_paths", "lint_self",
+    "CommReport", "check_comm", "recommend_tree",
+    "predict_collective_traffic",
     "IteratorsCheckerError", "check_task",
 ]
+
+_COMMCHECK = ("CommReport", "check_comm", "recommend_tree",
+              "predict_collective_traffic")
 
 
 def __getattr__(name):
     # the dynamic (PINS) checker lives with the prof components; lazy so
-    # importing the static analyzers never drags the profiling stack in
+    # importing the static analyzers never drags the profiling stack in.
+    # commcheck is lazy for the same reason (it pulls in the critpath
+    # size tiers) AND so runtime_report()'s comm_pattern block — keyed on
+    # sys.modules — only appears in processes that actually ran it
     if name in ("IteratorsCheckerError", "check_task"):
         from ..prof import iterators_checker
         return getattr(iterators_checker, name)
+    if name in _COMMCHECK:
+        from . import commcheck
+        return getattr(commcheck, name)
     raise AttributeError(name)

@@ -9,6 +9,7 @@ Usage::
     python -m parsec_tpu.analysis --self-lint [PATH ...]
     python -m parsec_tpu.analysis --graph cholesky --nt 6 --ranks 4
     python -m parsec_tpu.analysis --graph path/to/graph.jdf --bind NT=4
+    python -m parsec_tpu.analysis --comm [--ranks 8]   # comm patterns
 """
 
 from __future__ import annotations
@@ -19,9 +20,12 @@ import sys
 import numpy as np
 
 
-def _model_graphs(nt: int):
+def _model_graphs(nt: int, ranks: int = 1):
     """Small default instances of every shipped model builder — the same
-    registry the pytest gate sweeps."""
+    registry the pytest gate sweeps.  ``ranks > 1`` distributes the
+    vector-backed pools round-robin so commcheck sees cross-rank edges;
+    the dense-matrix and LLM pools stay single-home (classified
+    ``none`` — legitimately rank-local)."""
     from ..data_dist.matrix import (SymTwoDimBlockCyclic, TiledMatrix,
                                     TwoDimBlockCyclic, VectorTwoDimCyclic)
     from ..models import (cholesky, irregular, lu, pingpong, reduction,
@@ -30,7 +34,7 @@ def _model_graphs(nt: int):
     n = nt * nb
 
     def _vec(name):
-        return VectorTwoDimCyclic(name, lm=n, mb=nb,
+        return VectorTwoDimCyclic(name, lm=n, mb=nb, P=ranks,
                                   init_fn=lambda m, s: np.zeros(s,
                                                                 np.float32))
 
@@ -162,6 +166,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="verify for this many ranks (default 1)")
     ap.add_argument("--self-lint", action="store_true",
                     help="run runtimelint over parsec_tpu/ (or PATHs)")
+    ap.add_argument("--comm", action="store_true",
+                    help="derive every model pool's comm pattern "
+                         "statically (commcheck; --ranks defaults to 4 "
+                         "here so cross-rank edges exist)")
     ap.add_argument("paths", nargs="*", help="paths for --self-lint")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also print warnings")
@@ -169,7 +177,20 @@ def main(argv: list[str] | None = None) -> int:
 
     from . import check_jdf, check_ptg, lint_paths, lint_self
     failed = False
-    run_all = not args.graph and not args.self_lint
+    run_all = not args.graph and not args.self_lint and not args.comm
+
+    if args.comm:
+        from . import check_comm
+        ranks = args.ranks if args.ranks > 1 else 4
+        for gname, tp in _model_graphs(args.nt, ranks=ranks):
+            if args.graph and gname != args.graph:
+                continue
+            cr = check_comm(tp, nb_ranks=ranks)
+            print(cr.summary())
+            for f in cr.errors + (cr.warnings if args.verbose else []):
+                print("  " + repr(f))
+            failed |= not cr.ok
+        return 1 if failed else 0
 
     if args.graph or run_all:
         if args.graph and args.graph.endswith(".jdf"):

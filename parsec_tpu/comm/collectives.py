@@ -26,7 +26,9 @@ edge carries exactly one tile and the root applies the final combine.
 Tree shapes are the activation propagation shapes (``binomial | chain |
 star``, validated — an unknown kind raises
 :class:`~parsec_tpu.core.params.MCAParamValueError` instead of silently
-degrading).  ``redistribute_taskpool`` routes multi-consumer fan-out
+degrading).  ``comm_bcast_tree=auto`` resolves per payload class through
+:func:`~parsec_tpu.comm.remote_dep.resolve_tree_kind` — the same rule
+``analysis/commcheck.recommend_tree`` derives statically (docs/COMM.md).  ``redistribute_taskpool`` routes multi-consumer fan-out
 through the same staging (``data_dist/redistribute.py``).
 """
 
@@ -38,16 +40,35 @@ import numpy as np
 
 from ..core.params import params as _params
 from ..data.data import data_create
-from .remote_dep import (TREE_KINDS, _check_tree_kind, tree_children,
+from .remote_dep import (TREE_KINDS, resolve_tree_kind, tree_children,
                          tree_parent)
 
 __all__ = ["bcast_taskpool", "reduce_taskpool", "register_reduce_op",
-           "reduce_op", "tree_children", "tree_parent", "TREE_KINDS"]
+           "reduce_op", "tree_children", "tree_parent", "TREE_KINDS",
+           "resolve_tree_kind"]
+
+
+def _dtt_nbytes(V: Any) -> int | None:
+    """Per-tile payload hint for ``resolve_tree_kind`` under ``auto``."""
+    dtt = getattr(V, "default_dtt", None)
+    try:
+        return int(dtt.nbytes)
+    except Exception:
+        return None
 
 
 # ---------------------------------------------------------------------------
 # reduction op registry
 # ---------------------------------------------------------------------------
+
+# concurrency contract (analysis.runtimelint, docs/ANALYSIS.md): no
+# shared mutable state beyond the reduce-op registry, which follows the
+# register-at-import / read-at-build discipline (same as the codec and
+# PINS registries) — registration after pools are running is unsupported,
+# so the registry carries no lock.  The empty registry declares that:
+# nothing here may grow cross-thread mutation without growing an entry.
+_LOCK_PROTECTED = {}
+_LOCK_ORDER = ()
 
 _REDUCE_OPS: dict[str, Callable[[Any, Any], Any]] = {
     "sum": np.add,
@@ -106,8 +127,8 @@ def bcast_taskpool(V: Any, *, root: int = 0, n: int | None = None,
     from .. import ptg
 
     n = _positions(V, n)
-    kind = kind if kind is not None else _params.get("comm_bcast_tree")
-    _check_tree_kind(kind)
+    kind = resolve_tree_kind(
+        kind, nbytes=_dtt_nbytes(V), n=n)
     if not 0 <= root < n:
         raise ValueError(f"root {root} outside [0, {n})")
     kids = _max_children(kind, n)
@@ -157,8 +178,8 @@ def reduce_taskpool(V: Any, OUT: Any, *, op: str = "sum", root: int = 0,
     from .. import ptg
 
     n = _positions(V, n)
-    kind = kind if kind is not None else _params.get("comm_bcast_tree")
-    _check_tree_kind(kind)
+    kind = resolve_tree_kind(
+        kind, nbytes=_dtt_nbytes(V), n=n)
     if not 0 <= root < n:
         raise ValueError(f"root {root} outside [0, {n})")
     fn = reduce_op(op)

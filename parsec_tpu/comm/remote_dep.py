@@ -68,7 +68,10 @@ _params.register("comm_wire_datatypes", True,
                  "honor partial-tile wire datatypes ([type_remote/"
                  "displ_remote]) on remote edges; off ships full tiles")
 _params.register("comm_bcast_tree", "binomial",
-                 "multi-peer activation propagation: binomial|chain|star")
+                 "multi-peer activation propagation: binomial|chain|star, "
+                 "or auto (per-payload: resolve_tree_kind)")
+_params.declare_knob("comm_bcast_tree",
+                     values=("binomial", "chain", "star", "auto"))
 
 
 def _wire_value(value: Any) -> Any:
@@ -175,6 +178,36 @@ def _check_tree_kind(kind: str) -> None:
     if kind not in TREE_KINDS:
         from ..core.params import MCAParamValueError
         raise MCAParamValueError("comm_bcast_tree", kind, TREE_KINDS)
+
+
+def resolve_tree_kind(kind: str | None = None, *,
+                      nbytes: int | None = None,
+                      n: int | None = None) -> str:
+    """Resolve a tree-shape request (the ``comm_bcast_tree`` param when
+    ``kind`` is None) to a concrete member of :data:`TREE_KINDS`.
+
+    ``auto`` picks per payload class: payloads at or under
+    ``comm_short_limit`` on small meshes (≤8 participants) take the
+    latency-minimal star — they ride inline in the activation frame, so
+    root egress is one frame per peer either way; everything else takes
+    the egress-bounding binomial (the root re-serves at most ⌈log2 n⌉
+    copies).  ``analysis/commcheck.recommend_tree`` derives its
+    per-edge-class shapes through this same rule, so static advice and
+    runtime resolution cannot drift.
+
+    The wire never carries ``auto``: activation staging resolves once
+    per message and ships the concrete kind, since every hop re-derives
+    its children from ``msg["tree"]``."""
+    if kind is None:
+        kind = _params.get("comm_bcast_tree")
+    if kind == "auto":
+        if nbytes is not None and \
+                0 < nbytes <= _params.get("comm_short_limit") \
+                and (n if n is not None else 2) <= 8:
+            return "star"
+        return "binomial"
+    _check_tree_kind(kind)
+    return kind
 
 
 def tree_children(kind: str, position: int, n: int) -> list[int]:
@@ -525,6 +558,15 @@ class RemoteDepEngine:
 
         for flows, ranks in by_mask.items():
             ranks.sort()
+            # resolve the tree shape ONCE per activation message (the
+            # wire never carries "auto" — every hop re-derives children
+            # from msg["tree"]); the hint is the largest staged payload
+            hint = max((int(getattr(remote.outputs[fi].copy.value,
+                                    "nbytes", 0))
+                        for fi, _v in flows
+                        if remote.outputs[fi].copy is not None),
+                       default=0)
+            tree_kind = resolve_tree_kind(nbytes=hint, n=len(ranks) + 1)
             outputs = []
             for fi, view in flows:
                 out = remote.outputs[fi]
@@ -555,8 +597,7 @@ class RemoteDepEngine:
                         all_ranks = [self.my_rank] + ranks
                         child_ranks = [
                             all_ranks[p] for p in tree_children(
-                                _params.get("comm_bcast_tree"), 0,
-                                len(all_ranks))]
+                                tree_kind, 0, len(all_ranks))]
                         # snapshot at registration: a local successor may
                         # mutate the live host tile in place before the
                         # remote GET is served (the reference retains a
@@ -579,7 +620,7 @@ class RemoteDepEngine:
                 # participants: producer at position 0, consumers after —
                 # every hop re-derives its children from this list
                 "ranks": [self.my_rank] + ranks,
-                "tree": _params.get("comm_bcast_tree"),
+                "tree": tree_kind,
                 "priority": task.priority,
                 # the request's 8-byte trace context rides every hop of
                 # the propagation tree (prof/spans.py; 0 = untraced)

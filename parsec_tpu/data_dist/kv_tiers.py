@@ -56,6 +56,29 @@ _params.register("kv_host_tier_bytes", 0,
 AM_TAG_KV_SPILL = 24        # (key, version, ndarray) -> peer pins it
 AM_TAG_KV_SPILL_ACK = 25    # (key, mem-handle wire) -> spiller records it
 
+# concurrency contracts, enforced by analysis.runtimelint (docs/ANALYSIS.md):
+# the residency ledger and its gauges mutate only under the map's own
+# _lock (spill hooks, AM callbacks, and the prefetch path race freely);
+# the peer store's pin table likewise, including the mem-handle drain
+# callback.  Per-Data copy state is guarded by each Data's own _lock
+# (declared in data/data.py); KVTierMap only ever nests Data._lock
+# INSIDE its map lock released — never the two held together.
+_LOCK_PROTECTED = {
+    "KVTierMap._host": "_lock",
+    "KVTierMap._peer": "_lock",
+    "KVTierMap._spill_ref": "_lock",
+    "KVTierMap._issued": "_lock",
+    "KVTierMap.prefetch_inflight": "_lock",
+    "KVTierMap.prefetched_pages": "_lock",
+    "KVTierMap.spills": "_lock",
+    "KVTierMap.peer_spills": "_lock",
+    "KVTierMap.peer_fetches": "_lock",
+    "PeerKVStore._held": "_lock",
+    "PeerKVStore.pages_held": "_lock",
+    "PeerKVStore.bytes_held": "_lock",
+}
+_LOCK_ORDER = ("_lock",)
+
 
 class KVTierMap:
     """Residency ledger + prefetcher for one :class:`PagedKVCollection`
@@ -96,7 +119,7 @@ class KVTierMap:
             self.spills += 1
         self._maybe_spill_to_peer()
 
-    def _host_pages_locked(self) -> list[tuple[Any, Any, int]]:
+    def _host_pages_locked(self) -> list[tuple[Any, Any, int]]:  # lint: holds(_lock)
         """Live, still host-resident-only entries; prunes the rest."""
         out, dead = [], []
         for key, (ref, nb) in self._host.items():

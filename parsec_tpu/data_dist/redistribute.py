@@ -123,8 +123,7 @@ def redistribute_taskpool(src: TiledMatrix, dst: TiledMatrix,
         # consumer rank (quadratic at production rank counts)
         stage_src: dict[tuple, dict[int, object]] = {}
         if nranks > 1 and _params.get("redist_collective_fanout"):
-            from ..comm.remote_dep import tree_parent
-            kind = _params.get("comm_bcast_tree")
+            from ..comm.remote_dep import resolve_tree_kind, tree_parent
             consumers: dict[tuple, set[int]] = {}
             for (m, n), skey, _a in frags:
                 consumers.setdefault(skey, set()).add(dst.rank_of(m, n))
@@ -138,6 +137,10 @@ def redistribute_taskpool(src: TiledMatrix, dst: TiledMatrix,
                     continue
                 order = [owner] + remote          # tree positions
                 shape = src.tile_shape(*skey)
+                kind = resolve_tree_kind(
+                    nbytes=int(np.prod(shape))
+                    * np.dtype(src.dtype).itemsize,
+                    n=len(order))
                 stile = taskpool.tile_of(src, *skey)
                 tiles: dict[int, object] = {}
                 for pos in range(1, len(order)):

@@ -67,6 +67,26 @@ _params.register("llm_prefix_budget_bytes", 64 << 20,
 
 _entry_ids = itertools.count()
 
+# concurrency contracts, enforced by analysis.runtimelint (docs/ANALYSIS.md):
+# every tree structure (the trie's node children/entry lists, the LRU
+# ring, the byte gauge and hit/miss counters) mutates only under the
+# tree's RLock — match() on the serving hot path races donate()/evict()
+# from batcher drains; the ``*_locked`` helpers document the lock they
+# inherit.  _Entry fields are single-writer (built before publication,
+# touch stamped under the same lock).
+_LOCK_PROTECTED = {
+    "_Node.children": "_lock",
+    "_Node.entries": "_lock",
+    "PrefixTree._lru": "_lock",
+    "PrefixTree._clock": "_lock",
+    "PrefixTree._bytes": "_lock",
+    "PrefixTree.hits": "_lock",
+    "PrefixTree.misses": "_lock",
+    "PrefixTree.donations": "_lock",
+    "PrefixTree.evictions": "_lock",
+}
+_LOCK_ORDER = ("_lock",)
+
 
 class _Entry:
     """One retained prefix: a synthetic sequence in the KV collection

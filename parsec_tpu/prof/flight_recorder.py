@@ -502,6 +502,18 @@ def runtime_report(max_workers: int = 6) -> dict:
                 keep.add(name)
         return {n: snap[n] for n in sorted(keep) if n in snap}
     rep["knobs"] = _best_effort(_knobs, default={})
+    # statically derived comm patterns (ISSUE 20, analysis/commcheck.py):
+    # present only in processes that actually ran check_comm — the
+    # sys.modules gate keeps the analysis stack out of serving processes
+    # that never imported it.  Precedes the flightrec-disabled early
+    # return (the derivation is execution-independent evidence) and uses
+    # the compact form: runtime_report() has a hard size contract.
+    cmod = sys.modules.get("parsec_tpu.analysis.commcheck")
+    if cmod is not None:
+        cp = _best_effort(lambda: cmod.report_block(compact=True),
+                          default={})
+        if cp:
+            rep["comm_pattern"] = cp
     r = recorder
     if r is None:
         rep["flightrec"] = "disabled"

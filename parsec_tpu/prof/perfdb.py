@@ -66,7 +66,7 @@ _HIGHER_IS_BETTER = ("per_s", "gbps", "gflops", "throughput", "_hits",
                      "efficiency", "speedup", "rate", "_frac", "pct_")
 _LOWER_IS_BETTER = ("latency", "_wait", "_p50", "_p99", "dispatch",
                     "compile", "ttft", "overhead", "_err", "dropped",
-                    "_lost")
+                    "_lost", "_relerr")
 
 
 def better_of(metric: str) -> str:
@@ -362,6 +362,10 @@ def self_test() -> int:
         assert r["verdict"] == "regressed", r
         assert r["z"] > Z_THRESHOLD, r
         assert db2.check(k_lo, 1.0)["verdict"] == "improved"
+        # the commcheck agreement gate rides the _err direction: growing
+        # static-vs-wire disagreement must read as a regression
+        assert better_of("comm_agree_8r_err") == "lower"
+        assert better_of("bytes_relerr") == "lower"
         # cold keys warm silently
         k_new = make_key("selftest", "fresh_metric")
         assert db2.check(k_new, 5.0)["verdict"] == "warming"

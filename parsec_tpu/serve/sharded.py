@@ -46,7 +46,7 @@ from collections import deque
 from typing import Any, Sequence
 
 from ..comm.engine import AM_TAG_USER_BASE
-from ..comm.remote_dep import tree_children
+from ..comm.remote_dep import resolve_tree_kind, tree_children
 from ..core.future import Future
 from ..core.params import params as _params
 from ..prof import spans as _spans
@@ -60,6 +60,17 @@ AM_TAG_SERVE = AM_TAG_USER_BASE + 8      # the sharded-serve control tag
 _params.register("serve_shard_poll_s", 0.002,
                  "worker-loop poll interval of a non-frontend sharded "
                  "serving rank (serve_forever)")
+
+# concurrency contracts, enforced by analysis.runtimelint (docs/ANALYSIS.md):
+# only the live-stream table is shared across threads (the rank's
+# progress loop vs. drain_into callers); it mutates only under _lock.
+# The inbox deque is append-from-AM-callback / pop-from-progress —
+# thread-safe by deque's atomic ops; the frontend books (_handles,
+# _rank_load, _next_sid) are single-threaded frontend state by contract.
+_LOCK_PROTECTED = {
+    "ShardedRuntimeServer._live": "_lock",
+}
+_LOCK_ORDER = ("_lock",)
 
 
 class ShardedStreamTicket:
@@ -274,7 +285,9 @@ class ShardedRuntimeServer:
         self._forward_config(cfg)
 
     def _forward_config(self, cfg: dict) -> None:
-        kind = _params.get("comm_bcast_tree")
+        # every hop must derive the SAME concrete tree: resolve with no
+        # payload hint ("auto" -> binomial deterministically at any rank)
+        kind = resolve_tree_kind(n=self.nranks)
         for child in tree_children(kind, self.rank, self.nranks):
             self._send(child, cfg)
             self.config_forwards += 1

@@ -42,6 +42,15 @@ _params.register("tune_adaptive", False,
                  "llm_steps_per_pool settings and sweeps stay "
                  "authoritative unless the operator opts in")
 
+# concurrency contract (analysis.runtimelint, docs/ANALYSIS.md): this
+# module owns NO shared mutable state — every KnobController is
+# single-owner by design (one tenant's batcher loop drives it; see the
+# class docstring), and persistence goes through tune/db.py's guarded
+# cache.  The empty registry is the declaration: nothing here may grow
+# cross-thread mutation without also growing a lock and an entry.
+_LOCK_PROTECTED = {}
+_LOCK_ORDER = ()
+
 # controller cadence: how many observations one probe holds, and how
 # many observations a converged knob waits before probing again
 PROBE_LEN = 8

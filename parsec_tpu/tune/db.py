@@ -48,6 +48,21 @@ _params.register("tune_db_path", "",
                  "$PARSEC_TPU_ARTIFACT_DIR/tunedb.jsonl, else "
                  "/tmp/tunedb.jsonl)")
 
+# concurrency contracts (analysis.runtimelint, docs/ANALYSIS.md): the
+# process-wide parsed-generation cache mutates only under _cache_lock
+# (Context start and per-tenant submit probe it concurrently; declared
+# here as the module contract — the cache is a module global, so the
+# subscript sites are documentation, the `with _cache_lock` discipline
+# in cached_db is the enforcement).  TuneDB instances themselves are
+# intentionally NOT declared: a DB is single-owner (each cached
+# generation is parsed once before publication, then read-only; writers
+# append to their own handle), so adding a lock would tax the sub-50µs
+# consult path for a race that cannot occur.
+_LOCK_PROTECTED = {
+    "db._cached": "_cache_lock",
+}
+_LOCK_ORDER = ("_cache_lock",)
+
 
 def default_path() -> str:
     p = str(_params.get("tune_db_path") or "")

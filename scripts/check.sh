@@ -30,6 +30,11 @@ else
     echo "== runtimelint + graphcheck (every shipped model graph) =="
     python -m parsec_tpu.analysis
 
+    echo "== commcheck (static comm-pattern derivation: model sweep" \
+         "classified at 4 ranks + built-in invariants) =="
+    python -m parsec_tpu.analysis --comm
+    python -m parsec_tpu.analysis.commcheck --self-test
+
     echo "== tracemerge (cross-rank trace stitching self-test) =="
     python -m parsec_tpu.prof.tracemerge --self-test
 

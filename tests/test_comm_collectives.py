@@ -177,3 +177,50 @@ def test_bcast_8rank_multiproc_root_egress_logn():
         rx = r["peer_stats"]["rx"]
         srcs = [s for s, d in rx.items() if d["bytes"] >= payload]
         assert len(srcs) == 1, (r["rank"], srcs)
+
+    # static-vs-dynamic agreement (ISSUE 20): commcheck's executed-nothing
+    # byte prediction for this exact workload must agree with the wire
+    # ledger within 15% rel — framing, activations, and the reduction
+    # partials are the only slack on top of (n-1) payload transfers
+    from parsec_tpu.analysis.commcheck import (agreement_rel_err,
+                                               predict_collective_traffic)
+    pred = predict_collective_traffic(nranks)
+    observed = sum(d["bytes"] for r in res
+                   for d in r["peer_stats"]["tx"].values())
+    err = agreement_rel_err(pred["total_bytes"], observed)
+    assert err <= 0.15, (pred["total_bytes"], observed, err)
+    # the root-egress prediction is an UPPER bound on the root's own
+    # ledger: the staged re-serve can only shed root load onto interior
+    # ranks (see the egress comment above), never add to it
+    assert egress <= pred["root_egress_bytes"] + (1 << 20), \
+        (pred["root_egress_bytes"], egress)
+
+
+def test_bcast_4rank_auto_tree_root_egress_bounded():
+    """``comm_bcast_tree=auto`` (ISSUE 20): the resolved shape's measured
+    root egress must be <= the WORST hand-picked shape on the same
+    workload.  The 4 MiB payload is far past comm_short_limit, so auto
+    resolves to binomial — root serves children(0, 4) = {1, 2}: 2
+    payloads, vs star's worst-case 3; the wire must never carry the
+    literal "auto" (every rank's resolved tree is concrete)."""
+    nranks = 4
+    payload = int(params.get("comm_coll_bench_bytes"))     # 4 MiB
+    saved = params.get("comm_bcast_tree")
+    params.set("comm_bcast_tree", "auto")
+    try:
+        res = run_multiproc(
+            nranks, "parsec_tpu.comm.collectives:_mp_collective_body",
+            timeout=300, nb_cores=1)
+    finally:
+        params.set("comm_bcast_tree", saved)
+    digests = {r["digest"] for r in res}
+    assert len(digests) == 1, "auto-tree broadcast not byte-identical"
+    assert res[0]["tree"] == "auto"         # the param rode the env
+    egress = sum(d["bytes"]
+                 for d in res[0]["peer_stats"]["tx"].values())
+    # worst hand-picked shape is star: root serves n-1 = 3 payloads
+    assert egress <= (nranks - 1) * payload + (1 << 20), \
+        f"auto root egress {egress} exceeds the star worst case"
+    # and the binomial resolution beats it: 2 children + slack
+    assert egress <= 2 * payload + (1 << 20), \
+        f"auto did not resolve to the egress-bounding shape: {egress}"

@@ -1,0 +1,324 @@
+"""Static comm-pattern derivation (ISSUE 20, analysis/commcheck.py).
+
+Three tiers, mirroring test_analysis.py's discipline: the model-sweep
+classification contract (bcast -> broadcast, reduce -> reduce,
+single-rank -> none, every pool non-crashing), seeded-mutation coverage
+for each comm-hazard finding class with exact task-class/flow/instance
+provenance, and the tree-selection units (``recommend_tree`` /
+``resolve_tree_kind`` / the ``comm_bcast_tree=auto`` knob domain).
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import ptg
+from parsec_tpu.analysis import CommReport, check_comm, recommend_tree
+from parsec_tpu.analysis.__main__ import _model_graphs
+from parsec_tpu.analysis.commcheck import (PATTERNS, _classify,
+                                           agreement_rel_err,
+                                           predict_collective_traffic,
+                                           report_block)
+from parsec_tpu.comm.collectives import bcast_taskpool, reduce_taskpool
+from parsec_tpu.comm.remote_dep import (TREE_KINDS, resolve_tree_kind,
+                                        tree_children)
+from parsec_tpu.core.params import MCAParamValueError, params
+from parsec_tpu.data_dist.matrix import VectorTwoDimCyclic
+
+pytestmark = pytest.mark.analysis
+
+
+def _vec(name, n, mb=1024, P=1):
+    return VectorTwoDimCyclic(
+        name, lm=mb * n, mb=mb, P=P,
+        init_fn=lambda m, s: np.zeros(s, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# classification: the model sweep + the canonical pools
+# ---------------------------------------------------------------------------
+
+def test_model_sweep_classifies_every_pool():
+    """ISSUE-20 acceptance: every model pool gets a non-crashing
+    classification at 4 ranks; the collective pools and the single-home
+    pools land on their names."""
+    want = {"coll_bcast": "broadcast", "coll_reduce": "reduce",
+            "cholesky": "none", "stencil1d": "halo", "a2a": "all-to-all"}
+    seen = {}
+    for name, tp in _model_graphs(5, ranks=4):
+        cr = check_comm(tp, nb_ranks=4)
+        assert isinstance(cr, CommReport)
+        assert cr.pattern in PATTERNS, (name, cr.pattern)
+        assert cr.ok, (name, [repr(f) for f in cr.errors])
+        seen[cr.name] = cr.pattern
+    for pool, pattern in want.items():
+        assert seen.get(pool) == pattern, (pool, seen)
+    # the derivation feeds runtime_report(): every analyzed pool has a
+    # block with the critpath-keyed edge classes
+    blk = report_block()
+    assert set(want) <= set(blk)
+    assert blk["coll_bcast"]["pattern"] == "broadcast"
+    assert blk["coll_bcast"]["cross_rank_bytes"] > 0
+    assert all(":" in ec for ec in blk["coll_bcast"]["edge_classes"])
+
+
+def test_single_rank_pool_is_none():
+    cr = check_comm(bcast_taskpool(_vec("V", 8), n=8), nb_ranks=1)
+    assert cr.pattern == "none" and cr.total_bytes == 0, cr
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_bcast_reduce_patterns_and_bytes(n):
+    """Distributed collectives classify by name and the derived bytes are
+    exactly (n-1) payload transfers — what the wire acceptance measures."""
+    mb = 1024
+    cr = check_comm(bcast_taskpool(_vec("B", n, mb=mb, P=n), n=n),
+                    nb_ranks=n)
+    assert cr.pattern == "broadcast", cr
+    assert not cr.findings, [repr(f) for f in cr.findings]
+    assert cr.total_bytes == (n - 1) * mb * 4, cr.edge_bytes
+    # fan-out of the root matches the binomial children count
+    root_deg = cr.fan_out.get(0, 0)
+    assert root_deg == len(tree_children("binomial", 0, n)), cr.fan_out
+    cr = check_comm(reduce_taskpool(_vec("R", n, mb=mb, P=n),
+                                    _vec("O", 1, mb=mb), n=n), nb_ranks=n)
+    assert cr.pattern == "reduce", cr
+    assert cr.total_bytes == (n - 1) * mb * 4, cr.edge_bytes
+
+
+def test_classify_shapes_directly():
+    """The classifier units over synthetic rank-pair matrices."""
+    b = 100
+    # chain both ways: writeback spread disambiguates
+    chain = {(r, r + 1): b for r in range(3)}
+    assert _classify(chain, 4, {0}) == "reduce"
+    assert _classify(chain, 4, {0, 1, 2, 3}) == "broadcast"
+    star = {(0, d): b for d in range(1, 5)}
+    assert _classify(star, 5, {0, 1, 2, 3, 4}) == "broadcast"
+    gather = {(s, 0): b for s in range(1, 5)}
+    assert _classify(gather, 5, {0}) == "reduce"
+    ring = {}
+    for r in range(4):
+        ring[(r, (r + 1) % 4)] = b
+        ring[((r + 1) % 4, r)] = b
+    assert _classify(ring, 4, set()) == "halo"
+    a2a = {(s, d): b for s in range(4) for d in range(4) if s != d}
+    assert _classify(a2a, 4, set()) == "all-to-all"
+    # two unrelated arrows: neither a unique source nor a unique sink
+    assert _classify({(0, 2): b, (3, 1): b}, 4, set()) == "point-to-point"
+    assert _classify({}, 4, set()) == "none"
+
+
+# ---------------------------------------------------------------------------
+# seeded mutations: each hazard class detected with provenance
+# ---------------------------------------------------------------------------
+
+def test_detects_duplicate_activation():
+    """Mutation: duplicate one of B's succ arrows — the same payload now
+    activates the same remote consumer twice."""
+    n = 4
+    tp = bcast_taskpool(_vec("D", n, P=n), n=n)
+    fA = next(f for f in tp.task_classes_by_name["B"].flows if f.name == "A")
+    fA.deps_out.append(fA.deps_out[0])
+    cr = check_comm(tp, nb_ranks=n)
+    hits = [f for f in cr.findings if f.code == "duplicate-activation"]
+    assert hits, [repr(f) for f in cr.findings]
+    # provenance names the PRODUCER side of the doubled edge
+    assert hits[0].task_class == "B" and hits[0].flow == "A"
+    assert hits[0].instance is not None
+
+
+def _owner_pool():
+    """Two writers W(p) at V(p)'s home rank, two readers R(q) pinned to
+    rank 1 reading V(q), CTL-ordered behind their writer — clean: the
+    cross-rank read of V(0) is of a tile its owner writes back."""
+    V = _vec("V", 2, mb=8, P=2)
+    p_ = ptg.PTGBuilder("ownerw", V=V, N=2)
+    w = p_.task("W", p=ptg.span(0, lambda g, l: g.N - 1))
+    w.affinity("V", lambda g, l: (l.p,))
+    fw = w.flow("A", ptg.WRITE)
+    fw.input(new=True, dtt=V.default_dtt)
+    fw.output(data=("V", lambda g, l: (l.p,)))
+    wx = w.flow("X", ptg.CTL)
+    wx.output(succ=("R", "X", lambda g, l: {"q": l.p}))
+
+    @w.body
+    def wbody(es, task, g, l):
+        pass
+
+    r = p_.task("R", q=ptg.span(0, lambda g, l: g.N - 1))
+    r.affinity("V", lambda g, l: (1,))
+    fr = r.flow("B", ptg.READ)
+    fr.input(data=("V", lambda g, l: (l.q,)))
+    rx = r.flow("X", ptg.CTL)
+    rx.input(pred=("W", "X", lambda g, l: {"p": l.q}))
+
+    @r.body
+    def rbody(es, task, g, l):
+        pass
+
+    return p_.build()
+
+
+def test_detects_unowned_remote_read():
+    """Mutation (drop an owner write): guard W(0)'s writeback away while
+    W(1)'s survives — R(0)'s cross-rank read of V(0) now snapshots a
+    home copy nothing produces, in a collection the pool DOES write."""
+    clean = check_comm(_owner_pool(), nb_ranks=2)
+    assert not [f for f in clean.findings
+                if f.code == "unowned-remote-read"], clean.findings
+
+    tp = _owner_pool()
+    fw = next(f for f in tp.task_classes_by_name["W"].flows if f.name == "A")
+    wb = next(d for d in fw.deps_out if d.data_ref is not None)
+    wb.guard = lambda locals_: locals_["p"] != 0
+    cr = check_comm(tp, nb_ranks=2)
+    hits = [f for f in cr.findings if f.code == "unowned-remote-read"]
+    assert hits, [repr(f) for f in cr.findings]
+    # provenance names the READER of the never-written tile
+    assert hits[0].task_class == "R" and hits[0].flow == "B"
+    assert hits[0].instance is not None
+    assert "V" in hits[0].message
+
+
+def _waw_pool():
+    """Two writers on DIFFERENT ranks both writing back T(0), serialized
+    by a CTL chain W(0) -> W(1) — clean: ordered cross-rank WAW."""
+    V = _vec("V", 2, mb=8, P=2)
+    T = _vec("T", 1, mb=8, P=2)
+    p_ = ptg.PTGBuilder("waw", V=V, T=T, N=2)
+    w = p_.task("W", p=ptg.span(0, lambda g, l: g.N - 1))
+    w.affinity("V", lambda g, l: (l.p,))
+    fw = w.flow("A", ptg.WRITE)
+    fw.input(new=True, dtt=T.default_dtt)
+    fw.output(data=("T", lambda g, l: (0,)))
+    wx = w.flow("X", ptg.CTL)
+    wx.output(succ=("W", "Y", lambda g, l: {"p": l.p + 1}),
+              guard=lambda g, l: l.p + 1 < g.N)
+    wy = w.flow("Y", ptg.CTL)
+    wy.input(pred=("W", "X", lambda g, l: {"p": l.p - 1}),
+             guard=lambda g, l: l.p > 0)
+
+    @w.body
+    def wbody(es, task, g, l):
+        pass
+
+    return p_.build()
+
+
+def test_detects_cross_rank_unordered_write():
+    """Mutation (flip a CTL-ordered cross-rank write to unordered): strip
+    the CTL chain — the home copy's final state now rests on whichever
+    writeback message lands last."""
+    clean = check_comm(_waw_pool(), nb_ranks=2)
+    assert not [f for f in clean.findings
+                if f.code == "cross-rank-unordered-write"], clean.findings
+
+    tp = _waw_pool()
+    for f in tp.task_classes_by_name["W"].flows:
+        if f.is_ctl:
+            f.deps_in.clear()
+            f.deps_out.clear()
+    cr = check_comm(tp, nb_ranks=2)
+    hits = [f for f in cr.errors
+            if f.code == "cross-rank-unordered-write"]
+    assert hits, [repr(f) for f in cr.findings]
+    assert hits[0].task_class == "W" and hits[0].flow == "A"
+    assert hits[0].instance is not None
+    assert "T" in hits[0].message
+
+
+def test_detects_tree_shape_mismatch():
+    """A star-configured broadcast of payload-heavy tiles over 8 ranks is
+    degree-pathological (root serves n-1 copies); binomial is silent."""
+    n = 8
+    mb = 65536                       # 256 KiB tiles: far past short_limit
+    cr = check_comm(bcast_taskpool(_vec("W", n, mb=mb, P=n), n=n,
+                                   kind="star"), nb_ranks=n)
+    hits = [f for f in cr.warnings if f.code == "tree-shape-mismatch"]
+    assert hits, [repr(f) for f in cr.findings]
+    assert "star" in hits[0].message and "binomial" in hits[0].message
+    cr = check_comm(bcast_taskpool(_vec("W2", n, mb=mb, P=n), n=n),
+                    nb_ranks=n)
+    assert not [f for f in cr.findings
+                if f.code == "tree-shape-mismatch"], cr.findings
+
+
+# ---------------------------------------------------------------------------
+# tree selection: recommend_tree / resolve_tree_kind / the knob domain
+# ---------------------------------------------------------------------------
+
+def test_recommend_tree_per_edge_class():
+    n = 8
+    cr = check_comm(bcast_taskpool(_vec("H", n, mb=65536, P=n), n=n),
+                    nb_ranks=n)
+    rec = recommend_tree(cr)
+    assert rec["overall"] == "binomial", rec
+    assert all(k in TREE_KINDS for k in rec["per_class"].values()), rec
+    # a short-payload pool on a small mesh recommends the latency star
+    cr = check_comm(bcast_taskpool(_vec("S", 4, mb=64, P=4), n=4),
+                    nb_ranks=4)
+    assert recommend_tree(cr)["overall"] == "star", cr.edge_bytes
+
+
+def test_resolve_tree_kind_rule():
+    short = int(params.get("comm_short_limit"))
+    assert resolve_tree_kind("auto", nbytes=short, n=4) == "star"
+    assert resolve_tree_kind("auto", nbytes=short + 1, n=4) == "binomial"
+    assert resolve_tree_kind("auto", nbytes=64, n=16) == "binomial"
+    assert resolve_tree_kind("auto") == "binomial"      # no payload hint
+    assert resolve_tree_kind("chain", nbytes=1, n=2) == "chain"
+    assert resolve_tree_kind(None, nbytes=1 << 20) == \
+        params.get("comm_bcast_tree")
+    with pytest.raises(MCAParamValueError) as ei:
+        resolve_tree_kind("fanfic")
+    assert ei.value.param == "comm_bcast_tree"
+
+
+def test_auto_is_a_declared_knob_value():
+    """The PR-18 loop can search the tree shape: comm_bcast_tree is a
+    declared knob whose domain includes auto."""
+    spec = params.knob_space().get("comm_bcast_tree")
+    assert spec is not None
+    assert set(spec.values) == {"binomial", "chain", "star", "auto"}
+
+
+def test_bcast_pool_accepts_auto_kind():
+    """auto resolves at build time — the pool's concrete tree matches
+    the payload class, and graph shape follows the resolved kind."""
+    tp = bcast_taskpool(_vec("A1", 4, mb=64, P=4), n=4, kind="auto")
+    cr = check_comm(tp, nb_ranks=4)
+    assert cr.pattern == "broadcast"
+    assert cr.fan_out.get(0) == 3           # short payload -> star
+    tp = bcast_taskpool(_vec("A2", 4, mb=65536, P=4), n=4, kind="auto")
+    cr = check_comm(tp, nb_ranks=4)
+    assert cr.fan_out.get(0) == 2           # heavy payload -> binomial
+
+
+def test_predict_collective_traffic_shape():
+    pred = predict_collective_traffic(4, payload_bytes=1 << 16)
+    assert pred["bcast_pattern"] == "broadcast"
+    assert pred["reduce_pattern"] == "reduce"
+    # binomial root serves children(0,4) = {1,2}: exactly two payloads
+    assert pred["root_egress_bytes"] == 2 * (1 << 16), pred
+    assert pred["total_bytes"] == 3 * (1 << 16) + 3 * 256, pred
+    assert agreement_rel_err(100, 110) == pytest.approx(0.1)
+    assert agreement_rel_err(0, 50) == 50.0     # degenerate base guarded
+
+
+def test_runtime_report_carries_comm_pattern_block():
+    check_comm(bcast_taskpool(_vec("RB", 4, P=4), n=4), nb_ranks=4)
+    from parsec_tpu.prof.flight_recorder import runtime_report
+    rep = runtime_report()
+    assert "comm_pattern" in rep
+    assert rep["comm_pattern"]["coll_bcast"]["pattern"] == "broadcast"
+    assert rep["comm_pattern"]["coll_bcast"]["recommended_tree"] \
+        in TREE_KINDS
+
+
+def test_commcheck_cli_and_self_test(capsys):
+    from parsec_tpu.analysis.__main__ import main as cli_main
+    assert cli_main(["--comm", "--graph", "comm_bcast", "--nt", "4"]) == 0
+    out = capsys.readouterr().out
+    assert "broadcast" in out
+    from parsec_tpu.analysis.commcheck import main as cc_main
+    assert cc_main(["--self-test"]) == 0

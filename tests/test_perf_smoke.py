@@ -76,6 +76,11 @@ LLM_PREFIX_SKIPPED_FRAC_MIN = 0.8
 # 10% of the non-speculative path — the second gate lives in
 # tests/test_llm_spec.py where the drafter can be forced adversarial
 LLM_SPEC_SPEEDUP_MIN = 1.5
+# ISSUE-20 commcheck: the static byte prediction for the collective
+# rank sweep must agree with the measured peer_stats wire ledger within
+# 15% rel (deterministic workload: (n-1) payload transfers + small
+# reduction partials; framing and activation frames are the only slack)
+COMMCHECK_AGREE_RELERR_MAX = 0.15
 
 
 def test_compiled_dispatch_latency():
@@ -385,3 +390,27 @@ def test_tuned_cholesky_recovers_seeded_bad_tile(param, tmp_path):
     assert r["best_nb"] != r["nb_bad"], r
     assert r["tile00_abs_err"] <= 1e-3, r
     assert Path(r["db_path"]).exists(), r
+
+
+@pytest.mark.parametrize("nranks", [2, 4])
+def test_commcheck_static_vs_wire_agreement(nranks):
+    """ISSUE-20 agreement gate at the comm_ranks smoke points: commcheck
+    predicts the collective sweep's cross-rank bytes WITHOUT executing,
+    and the measured socket ledger (summed tx across every rank) must
+    land within 15% rel of it — drift on either side (a static model
+    that forgot an edge, a wire path that started double-shipping)
+    fails here by name."""
+    from parsec_tpu.analysis.commcheck import (agreement_rel_err,
+                                               predict_collective_traffic)
+    from parsec_tpu.comm.multiproc import run_multiproc
+    pred = predict_collective_traffic(nranks)
+    assert pred["bcast_pattern"] == "broadcast", pred
+    assert pred["reduce_pattern"] == "reduce", pred
+    res = run_multiproc(
+        nranks, "parsec_tpu.comm.collectives:_mp_collective_body",
+        timeout=240, nb_cores=1)
+    observed = sum(d["bytes"] for r in res
+                   for d in r["peer_stats"]["tx"].values())
+    err = agreement_rel_err(pred["total_bytes"], observed)
+    assert err <= COMMCHECK_AGREE_RELERR_MAX, \
+        (pred["total_bytes"], observed, err)
